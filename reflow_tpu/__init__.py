@@ -1,0 +1,40 @@
+"""reflow_tpu — a TPU-native incremental (change-driven) dataflow framework.
+
+Capability parity target: LDuderino/reflow (see SURVEY.md — the reference
+mount was empty at survey time, so parity is against the reconstructed
+capability spec in SURVEY.md §0–§2, derived from trusted driver metadata in
+BASELINE.json).
+
+Model
+-----
+Users build a :class:`~reflow_tpu.graph.FlowGraph` of keyed dataflow
+operators (Map, Filter, GroupBy, Reduce, Join) over *collections*: multisets
+of ``(key, value)`` rows with signed integer multiplicities (weights).
+Changes enter the graph as *deltas* — batches of ``(key, value, weight)``
+rows where ``weight > 0`` inserts and ``weight < 0`` retracts — and a
+:class:`~reflow_tpu.scheduler.DirtyScheduler` recomputes only the invalidated
+nodes each tick. Execution is pluggable behind the
+:class:`~reflow_tpu.executors.Executor` interface: the NumPy
+:class:`~reflow_tpu.executors.CpuExecutor` is the default correctness oracle,
+and the JAX :class:`~reflow_tpu.executors.TpuExecutor` lowers each tick's
+dirty batch to a single jit-compiled XLA step over device-resident, padded,
+optionally mesh-sharded delta buffers.
+"""
+
+from reflow_tpu.delta import DeltaBatch, Spec
+from reflow_tpu.graph import FlowGraph
+from reflow_tpu.scheduler import DirtyScheduler
+from reflow_tpu.executors import CpuExecutor, Executor, get_executor
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DeltaBatch",
+    "Spec",
+    "FlowGraph",
+    "DirtyScheduler",
+    "Executor",
+    "CpuExecutor",
+    "get_executor",
+    "__version__",
+]

@@ -1,0 +1,28 @@
+"""Executor plugin interface (SURVEY.md §2 items 9–10).
+
+Execution of a tick's dirty batch is pluggable: the NumPy/dict
+:class:`CpuExecutor` is the default path and correctness oracle; the JAX
+:class:`TpuExecutor` lowers each pass to one jit-compiled XLA step.
+Executors are registered by name so the choice is a config flag
+(SURVEY.md §5: the one load-bearing flag).
+"""
+
+from reflow_tpu.executors.base import Executor, register_executor, get_executor
+from reflow_tpu.executors.cpu import CpuExecutor
+
+__all__ = ["Executor", "CpuExecutor", "register_executor", "get_executor"]
+
+
+def _lazy_tpu():
+    # Imported lazily so host-only use never pays the jax import.
+    try:
+        from reflow_tpu.executors.tpu import TpuExecutor  # noqa: F401
+    except ImportError as e:
+        raise NotImplementedError(
+            "the 'tpu' executor requires jax and reflow_tpu.executors.tpu "
+            f"(import failed: {e})") from e
+    return TpuExecutor
+
+
+register_executor("cpu", CpuExecutor)
+register_executor("tpu", _lazy_tpu)

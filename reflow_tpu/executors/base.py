@@ -1,0 +1,77 @@
+"""Executor ABC: run one pass of a tick's dirty plan.
+
+The scheduler computes *what* to run (dirty plan, structural — no device
+values are consulted, keeping host↔device traffic at the graph boundary per
+the north star); the executor decides *how*. The contract:
+
+``run_pass(plan, ingress) -> egress``
+
+- ``plan``: topo-ordered dirty nodes (sources/loops first).
+- ``ingress``: {node_id: DeltaBatch} for the dirty source/loop nodes.
+- ``egress``: {node_id: DeltaBatch} for every sink in the plan **and** every
+  loop node whose back-edge produced deltas this pass (the scheduler re-ticks
+  those). Internal edges never cross the executor boundary.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Callable, Dict, List, Sequence, Union
+
+from reflow_tpu.delta import DeltaBatch
+from reflow_tpu.graph import FlowGraph, Node
+
+__all__ = ["Executor", "register_executor", "get_executor"]
+
+
+class Executor(abc.ABC):
+    name: str = "?"
+
+    def __init__(self):
+        self.graph: FlowGraph | None = None
+        self.states: Dict[int, object] = {}
+
+    def bind(self, graph: FlowGraph) -> None:
+        """Attach to a validated graph and allocate per-node state."""
+        self.graph = graph
+        self.states = {
+            n.id: n.op.initial_state()
+            for n in graph.nodes
+            if n.kind == "op" and n.op is not None
+        }
+
+    @abc.abstractmethod
+    def run_pass(self, plan: Sequence[Node],
+                 ingress: Dict[int, DeltaBatch]) -> Dict[int, DeltaBatch]:
+        ...
+
+    # -- checkpoint seam (SURVEY.md §5) -----------------------------------
+
+    def state_snapshot(self) -> Dict[int, object]:
+        """Host-representable snapshot of all per-node operator state.
+
+        Deep-copied: ops mutate their state in place, so a shallow copy
+        would alias live state and be invalidated by the next tick.
+        """
+        import copy
+
+        return copy.deepcopy(self.states)
+
+    def state_restore(self, snapshot: Dict[int, object]) -> None:
+        self.states = dict(snapshot)
+
+
+_REGISTRY: Dict[str, Union[type, Callable[[], type]]] = {}
+
+
+def register_executor(name: str, cls_or_thunk) -> None:
+    _REGISTRY[name] = cls_or_thunk
+
+
+def get_executor(name: str, **kwargs) -> Executor:
+    """Instantiate a registered executor by name ('cpu' is the default path)."""
+    if name not in _REGISTRY:
+        raise KeyError(f"no executor {name!r}; registered: {sorted(_REGISTRY)}")
+    entry = _REGISTRY[name]
+    cls = entry if isinstance(entry, type) else entry()
+    return cls(**kwargs)

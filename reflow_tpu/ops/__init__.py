@@ -1,0 +1,22 @@
+"""Operator library: Map, Filter, GroupBy, Reduce, Join, Union.
+
+SURVEY.md §2 items 2–6. Each op defines pure functional incremental
+semantics ``(state, in_deltas) -> (state', out_deltas)`` over the multiset
+delta algebra (see ``delta.py``). The definitions here are the host-side
+oracle semantics (exact, dict/Counter-based); the TPU executor lowers the
+same ops to padded device arrays + segment/collective primitives
+(``executors/tpu.py``) and is differentially tested against these.
+"""
+
+from reflow_tpu.ops.core import (
+    Op,
+    Map,
+    Filter,
+    GroupBy,
+    Reduce,
+    Join,
+    Union,
+    REDUCERS,
+)
+
+__all__ = ["Op", "Map", "Filter", "GroupBy", "Reduce", "Join", "Union", "REDUCERS"]

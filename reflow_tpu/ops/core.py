@@ -1,0 +1,339 @@
+"""Core operator definitions + exact host-side incremental semantics.
+
+Every op implements:
+
+- ``arity``: number of input ports.
+- ``initial_state()``: host-side state (the TPU executor builds its own
+  device state; see ``executors/tpu.py``).
+- ``apply(state, in_batches) -> out_batch``: consume one tick's deltas on
+  each port, mutate/replace state, emit output deltas. Must satisfy the
+  incremental-vs-full oracle property (SURVEY.md §4b): folding the emitted
+  deltas equals recomputing the op on the fully accumulated input.
+
+Ops are data: the graph stores them; executors interpret or lower them.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import Counter, defaultdict
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import numpy as np
+
+from reflow_tpu.delta import DeltaBatch, Spec, counter_to_batch
+
+__all__ = ["Op", "Map", "Filter", "GroupBy", "Reduce", "Join", "Union", "REDUCERS"]
+
+
+class Op:
+    """Base operator. Subclasses are declarative; executors do the work."""
+
+    arity: int = 1
+    kind: str = "op"
+
+    def initial_state(self) -> Any:
+        return None
+
+    def out_spec(self, in_specs: Sequence[Spec]) -> Spec:
+        return in_specs[0]
+
+    def apply(self, state: Any, in_batches: Sequence[DeltaBatch]) -> DeltaBatch:
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}()"
+
+
+class Map(Op):
+    """Pure per-row value transform; key and weight preserved.
+
+    ``fn(value) -> value'``. If ``vectorized``, ``fn`` is applied to the
+    whole values column at once (NumPy on CPU, jax.Array on TPU); otherwise
+    it is applied per row on CPU and wrapped in ``jax.vmap`` on TPU.
+    """
+
+    kind = "map"
+
+    def __init__(self, fn: Callable, *, vectorized: bool = False,
+                 out_spec: Optional[Spec] = None):
+        self.fn = fn
+        self.vectorized = vectorized
+        self._out_spec = out_spec
+
+    def out_spec(self, in_specs):
+        return self._out_spec if self._out_spec is not None else in_specs[0]
+
+    def apply(self, state, in_batches):
+        (b,) = in_batches
+        if len(b) == 0:
+            return DeltaBatch.empty(self._out_spec)
+        if self.vectorized:
+            vals = np.asarray(self.fn(b.values))
+        else:
+            vals = np.array([self.fn(v) for v in b.values], dtype=object)
+        return DeltaBatch(b.keys, vals, b.weights)
+
+
+class Filter(Op):
+    """Keep rows where ``pred(value)`` holds; key/weight preserved.
+
+    Same vectorization contract as :class:`Map`.
+    """
+
+    kind = "filter"
+
+    def __init__(self, pred: Callable, *, vectorized: bool = False):
+        self.pred = pred
+        self.vectorized = vectorized
+
+    def apply(self, state, in_batches):
+        (b,) = in_batches
+        if len(b) == 0:
+            return b
+        if self.vectorized:
+            mask = np.asarray(self.pred(b.values), dtype=bool)
+        else:
+            mask = np.array([bool(self.pred(v)) for v in b.values])
+        return DeltaBatch(b.keys[mask], b.values[mask], b.weights[mask])
+
+
+class GroupBy(Op):
+    """Re-key rows: ``key' = key_fn(key, value)``; value/weight preserved
+    unless ``value_fn`` is given.
+
+    Feeds :class:`Reduce` (SURVEY.md §2 item 6). On TPU a re-key is what
+    triggers cross-shard routing (``all_to_all`` on the key axis).
+    """
+
+    kind = "groupby"
+
+    def __init__(self, key_fn: Callable, value_fn: Optional[Callable] = None,
+                 *, vectorized: bool = False, out_spec: Optional[Spec] = None):
+        self.key_fn = key_fn
+        self.value_fn = value_fn
+        self.vectorized = vectorized
+        self._out_spec = out_spec
+
+    def out_spec(self, in_specs):
+        return self._out_spec if self._out_spec is not None else in_specs[0]
+
+    def apply(self, state, in_batches):
+        (b,) = in_batches
+        if len(b) == 0:
+            return b
+        if self.vectorized:
+            keys = np.asarray(self.key_fn(b.keys, b.values))
+            vals = (np.asarray(self.value_fn(b.keys, b.values))
+                    if self.value_fn else b.values)
+        else:
+            keys = np.array([self.key_fn(k, v) for k, v in zip(b.keys, b.values)],
+                            dtype=object)
+            vals = (np.array([self.value_fn(k, v) for k, v in zip(b.keys, b.values)],
+                             dtype=object)
+                    if self.value_fn else b.values)
+        return DeltaBatch(keys, vals, b.weights)
+
+
+# -- Reduce ---------------------------------------------------------------
+
+def _agg_sum(ms: Counter) -> float:
+    return sum(v * w for v, w in ms.items())
+
+
+def _agg_count(ms: Counter) -> int:
+    return sum(ms.values())
+
+
+def _agg_mean(ms: Counter) -> float:
+    n = sum(ms.values())
+    return _agg_sum(ms) / n
+
+
+def _agg_min(ms: Counter):
+    return min(v for v, w in ms.items() if w > 0)
+
+
+def _agg_max(ms: Counter):
+    return max(v for v, w in ms.items() if w > 0)
+
+
+_EMPTY_MS: Counter = Counter()
+
+#: name -> (aggregate_fn, linear?) — linear reducers lower to pure
+#: scatter-add on device; non-linear ones need multiset state (host) or
+#: recompute-on-retract (device, bounded key groups).
+REDUCERS = {
+    "sum": (_agg_sum, True),
+    "count": (_agg_count, True),
+    "mean": (_agg_mean, True),
+    "min": (_agg_min, False),
+    "max": (_agg_max, False),
+}
+
+
+class _NoAgg:
+    """Sentinel: the group has no defined aggregate (empty / degenerate)."""
+
+    def __repr__(self):
+        return "<no-agg>"
+
+
+_NO_AGG = _NoAgg()
+
+
+class Reduce(Op):
+    """Incremental keyed aggregation with persistent per-key state.
+
+    Emits the *change in the aggregate*: retract the previously **emitted**
+    aggregate, insert the new one (each weight ±1); a group appearing emits
+    only the insert, a group vanishing only the retract. ``tol`` suppresses
+    emission when a float aggregate moved by ≤ tol — this is what lets
+    iterative graphs (PageRank) quiesce. Retractions are always against the
+    last emitted value (not the raw state aggregate), so tol-suppressed
+    drift never corrupts downstream views.
+
+    Oracle state: ``{key: (Counter(value -> weight), last_emitted_agg)}`` —
+    exact for all reducers including non-invertible min/max. Multisets with
+    negative or mixed-sign multiplicities (legal transients in the
+    differential algebra) are preserved, not discarded.
+    """
+
+    kind = "reduce"
+
+    def __init__(self, how: str = "sum", *, tol: float = 0.0,
+                 out_spec: Optional[Spec] = None):
+        if how not in REDUCERS:
+            raise ValueError(f"unknown reducer {how!r}; have {sorted(REDUCERS)}")
+        self.how = how
+        self.tol = tol
+        self._out_spec = out_spec
+
+    def out_spec(self, in_specs):
+        return self._out_spec if self._out_spec is not None else in_specs[0]
+
+    def initial_state(self):
+        return {}
+
+    def _aggregate(self, ms: Counter):
+        """Aggregate of a (possibly mixed-sign) multiset, or _NO_AGG."""
+        if not ms:
+            return _NO_AGG
+        if self.how in ("min", "max"):
+            if not any(w > 0 for w in ms.values()):
+                return _NO_AGG
+        elif self.how == "mean":
+            if sum(ms.values()) == 0:
+                return _NO_AGG
+        fn, _ = REDUCERS[self.how]
+        return fn(ms)
+
+    def apply(self, state, in_batches):
+        (b,) = in_batches
+        tick: dict = defaultdict(Counter)
+        for k, v, w in b.rows():
+            tick[k][v] += w
+        out: Counter = Counter()
+        for k, dms in tick.items():
+            old_ms, emitted = state.get(k, (_EMPTY_MS, _NO_AGG))
+            new_ms = Counter(old_ms)
+            for v, w in dms.items():
+                new_ms[v] += w
+            new_ms = Counter({v: w for v, w in new_ms.items() if w != 0})
+            new_agg = self._aggregate(new_ms)
+            if emitted is _NO_AGG and new_agg is not _NO_AGG:
+                out[(k, new_agg)] += 1
+                emitted = new_agg
+            elif emitted is not _NO_AGG and new_agg is _NO_AGG:
+                out[(k, emitted)] -= 1
+                emitted = _NO_AGG
+            elif emitted is not _NO_AGG and not _close(emitted, new_agg, self.tol):
+                out[(k, emitted)] -= 1
+                out[(k, new_agg)] += 1
+                emitted = new_agg
+            if new_ms or emitted is not _NO_AGG:
+                state[k] = (new_ms, emitted)
+            else:
+                state.pop(k, None)
+        return counter_to_batch(out, like=b)
+
+
+def _close(a, b, tol: float) -> bool:
+    if tol <= 0.0:
+        return a == b
+    try:
+        return bool(abs(a - b) <= tol) or (isinstance(a, float) and isinstance(b, float)
+                                           and math.isnan(a) and math.isnan(b))
+    except TypeError:
+        return a == b
+
+
+class Join(Op):
+    """Incremental binary equi-join with per-side multiset state.
+
+    δ(A⋈B) = δA⋈B + (A+δA)⋈δB. Output rows are
+    ``(key, merge(key, va, vb))`` with weight ``wa*wb``; ``merge`` defaults
+    to the tuple ``(va, vb)``.
+    """
+
+    kind = "join"
+    arity = 2
+
+    def __init__(self, merge: Optional[Callable] = None, *,
+                 out_spec: Optional[Spec] = None):
+        self.merge = merge
+        self._out_spec = out_spec
+
+    def out_spec(self, in_specs):
+        if self._out_spec is not None:
+            return self._out_spec
+        return in_specs[0]
+
+    def initial_state(self):
+        return (defaultdict(Counter), defaultdict(Counter))
+
+    def _emit(self, out: Counter, k, va, wa, vb, wb):
+        v = self.merge(k, va, vb) if self.merge else (va, vb)
+        out[(k, v)] += wa * wb
+
+    def apply(self, state, in_batches):
+        left, right = state
+        da, db = in_batches
+        out: Counter = Counter()
+        # δA ⋈ B (old B)
+        for k, va, wa in da.rows():
+            for vb, wb in right[k].items():
+                if wb:
+                    self._emit(out, k, va, wa, vb, wb)
+        # fold δA into A
+        for k, va, wa in da.rows():
+            left[k][va] += wa
+            if left[k][va] == 0:
+                del left[k][va]
+            if not left[k]:
+                del left[k]
+        # (A + δA) ⋈ δB
+        for k, vb, wb in db.rows():
+            for va, wa in left[k].items():
+                if wa:
+                    self._emit(out, k, va, wa, vb, wb)
+        # fold δB into B
+        for k, vb, wb in db.rows():
+            right[k][vb] += wb
+            if right[k][vb] == 0:
+                del right[k][vb]
+            if not right[k]:
+                del right[k]
+        return counter_to_batch(out, like=da if len(da) else db)
+
+
+class Union(Op):
+    """Multiset union (addition) of n same-spec delta streams."""
+
+    kind = "union"
+
+    def __init__(self, arity: int = 2):
+        self.arity = arity
+
+    def apply(self, state, in_batches):
+        return DeltaBatch.concat(in_batches)

@@ -1,0 +1,162 @@
+"""DirtyScheduler: the change-driven recompute loop (SURVEY.md §2 #8, §3 #2).
+
+Tick protocol (tick-synchronous, batched — SURVEY.md §0):
+
+1. ``push`` buffers deltas at sources (host boundary in).
+2. ``tick()`` drains the buffers, computes the structural dirty frontier
+   (nodes reachable from dirty sources, in topo order — no device values are
+   consulted), and hands the plan to the executor.
+3. Deltas arriving on back-edges re-enter at loop nodes; the scheduler
+   re-runs the (restricted) plan until quiescence or ``max_loop_iters`` —
+   this is the host-driven fixpoint for iterative graphs like PageRank.
+4. Sink deltas are folded into materialized host views (host boundary out).
+
+The scheduler is deliberately cheap, host-side Python: all heavy lifting is
+in the executor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import Counter, defaultdict
+from typing import Dict, List, Optional, Sequence
+
+from reflow_tpu.delta import DeltaBatch
+from reflow_tpu.executors import CpuExecutor, Executor
+from reflow_tpu.graph import FlowGraph, GraphError, Node
+
+__all__ = ["DirtyScheduler", "TickResult"]
+
+
+@dataclasses.dataclass
+class TickResult:
+    """Per-tick observability record (SURVEY.md §5 metrics)."""
+
+    tick: int
+    sink_deltas: Dict[str, DeltaBatch]
+    passes: int
+    dirty_nodes: int
+    deltas_in: int
+    deltas_out: int
+    wall_s: float
+    quiesced: bool
+
+    @property
+    def delta_ops(self) -> int:
+        """Delta rows processed — numerator of delta-ops/sec (BASELINE.md)."""
+        return self.deltas_in + self.deltas_out
+
+
+class DirtyScheduler:
+    def __init__(self, graph: FlowGraph, executor: Optional[Executor] = None,
+                 *, max_loop_iters: int = 10_000):
+        graph.validate()
+        self.graph = graph
+        self.executor = executor if executor is not None else CpuExecutor()
+        self.executor.bind(graph)
+        self.max_loop_iters = max_loop_iters
+        self._pending: Dict[int, List[DeltaBatch]] = defaultdict(list)
+        self._tick = 0
+        self.sink_views: Dict[str, Counter] = {s.name: Counter() for s in graph.sinks}
+        self.history: List[TickResult] = []
+
+    # -- host boundary in --------------------------------------------------
+
+    def push(self, source: Node, batch: DeltaBatch) -> None:
+        if source.kind != "source":
+            raise GraphError(f"can only push to sources, not {source}")
+        if len(batch):
+            self._pending[source.id].append(batch)
+
+    # -- dirty planning (structural) --------------------------------------
+
+    def _dirty_plan(self, dirty_roots: Sequence[int]) -> List[Node]:
+        dirty = set(dirty_roots)
+        plan = []
+        for node in self.graph.nodes:  # construction order == topo order
+            if node.id in dirty:
+                plan.append(node)
+                continue
+            if node.kind in ("source", "loop"):
+                continue
+            if any(i.id in dirty for i in node.inputs):
+                dirty.add(node.id)
+                plan.append(node)
+        return plan
+
+    # -- the tick ----------------------------------------------------------
+
+    def tick(self) -> TickResult:
+        t0 = time.perf_counter()
+        ingress: Dict[int, DeltaBatch] = {
+            nid: DeltaBatch.concat(batches)
+            for nid, batches in self._pending.items()
+        }
+        self._pending.clear()
+        deltas_in = sum(len(b) for b in ingress.values())
+        deltas_out = 0
+        passes = 0
+        dirty_union: set = set()
+        sink_deltas: Dict[str, List[DeltaBatch]] = defaultdict(list)
+        quiesced = True
+        sink_ids = {s.id: s for s in self.graph.sinks}
+
+        while ingress:
+            if passes >= self.max_loop_iters:
+                quiesced = False
+                break
+            plan = self._dirty_plan(list(ingress))
+            dirty_union.update(n.id for n in plan)
+            egress = self.executor.run_pass(plan, ingress)
+            passes += 1
+            ingress = {}
+            for nid, batch in egress.items():
+                if nid in sink_ids:
+                    if len(batch):
+                        sink_deltas[sink_ids[nid].name].append(batch)
+                elif len(batch):  # loop back-edge -> next pass
+                    ingress[nid] = batch
+                    deltas_in += len(batch)
+
+        out: Dict[str, DeltaBatch] = {}
+        for name, batches in sink_deltas.items():
+            merged = DeltaBatch.concat(batches).consolidate()
+            out[name] = merged
+            deltas_out += len(merged)
+            view = self.sink_views[name]
+            for k, v, w in merged.rows():
+                view[(k, v)] += w
+                if view[(k, v)] == 0:
+                    del view[(k, v)]
+
+        self._tick += 1
+        result = TickResult(
+            tick=self._tick,
+            sink_deltas=out,
+            passes=passes,
+            dirty_nodes=len(dirty_union),
+            deltas_in=deltas_in,
+            deltas_out=deltas_out,
+            wall_s=time.perf_counter() - t0,
+            quiesced=quiesced,
+        )
+        self.history.append(result)
+        return result
+
+    # -- host boundary out -------------------------------------------------
+
+    def view(self, sink: str | Node) -> Counter:
+        """Materialized multiset {(key, value): weight} at a sink."""
+        name = sink if isinstance(sink, str) else sink.name
+        return self.sink_views[name]
+
+    def view_dict(self, sink: str | Node) -> Dict:
+        """Materialized {key: value} for unique-keyed sink collections."""
+        d: Dict = {}
+        for (k, v), w in self.view(sink).items():
+            if w > 0:
+                if k in d:
+                    raise GraphError(f"sink {sink} is not unique-keyed at {k!r}")
+                d[k] = v
+        return d

@@ -1,0 +1,8 @@
+"""The five reference benchmark workloads (SURVEY.md §2 item 12 / BASELINE.md):
+
+1. ``wordcount``   — incremental word-count (Map→Reduce, CPU default path)
+2. ``tfidf``       — streaming TF-IDF (Map / GroupBy / Reduce)
+3. ``pagerank``    — incremental PageRank (iterative Join + Reduce; north star)
+4. ``knn``         — k-NN re-index (vmapped cosine + Pallas top-k)
+5. ``image_embed`` — ViT-B feature extract → incremental groupby-agg
+"""

@@ -1,0 +1,63 @@
+"""Benchmark config 1: incremental word-count (single Map→Reduce).
+
+Tokenization happens at the host boundary (source ingest) per the north
+star's "host callbacks only at graph sources and sinks"; the graph itself is
+Map (normalize) → Reduce (count). Raw word strings are the keys on the CPU
+path; for the TPU path the ingest helper hashes words into an integer key
+space via a host-side vocabulary.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+from reflow_tpu.delta import DeltaBatch, Spec
+from reflow_tpu.graph import FlowGraph, Node
+
+_TOKEN = re.compile(r"[A-Za-z0-9']+")
+
+
+def tokenize(line: str) -> List[str]:
+    return [t.lower() for t in _TOKEN.findall(line)]
+
+
+def build_graph(key_space: int = 0) -> Tuple[FlowGraph, Node, Node]:
+    """Map→Reduce word-count graph. Returns (graph, source, sink).
+
+    The classic shape: Map projects each token row to the countable unit
+    ``1.0`` (so upstream payloads don't matter), Reduce('sum') folds
+    ``value*weight`` per word.
+    """
+    spec = Spec((), np.float32, key_space=key_space)
+    g = FlowGraph("wordcount")
+    words = g.source("words", spec)
+    ones = g.map(words, lambda v: np.ones_like(v), vectorized=True,
+                 name="to_ones")
+    counts = g.reduce(ones, "sum", name="counts", spec=spec)
+    out = g.sink(counts, "out")
+    return g, words, out
+
+
+def ingest_lines(lines: Iterable[str], weight: int = 1,
+                 vocab: Optional[Dict[str, int]] = None) -> DeltaBatch:
+    """Host-side ingest: tokenize lines into (word, 1) delta rows.
+
+    With ``vocab``, words are interned to dense int keys (extending the
+    vocab in place) for integer-keyed / TPU graphs.
+    """
+    keys: List = []
+    for line in lines:
+        for tok in tokenize(line):
+            if vocab is not None:
+                tok = vocab.setdefault(tok, len(vocab))
+            keys.append(tok)
+    n = len(keys)
+    if vocab is not None:
+        karr = np.array(keys, dtype=np.int64)
+    else:
+        karr = np.array(keys, dtype=object)
+    return DeltaBatch(karr, np.ones(n, dtype=np.float32),
+                      np.full(n, weight, dtype=np.int64))

@@ -1,0 +1,12 @@
+"""Test env: force an 8-device virtual CPU mesh before jax import.
+
+SURVEY.md §4d: mesh/collective/topo-partition tests run on CPU in CI via
+``xla_force_host_platform_device_count`` — no TPU hardware required.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

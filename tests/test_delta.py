@@ -1,0 +1,36 @@
+import numpy as np
+
+from reflow_tpu.delta import DeltaBatch, Spec, collection_counter
+
+
+def test_empty():
+    b = DeltaBatch.empty()
+    assert len(b) == 0
+    assert len(DeltaBatch.concat([b, b])) == 0
+
+
+def test_from_pairs_and_consolidate():
+    b = DeltaBatch.from_pairs([("a", 1), ("b", 2), ("a", 1)])
+    assert len(b) == 3
+    c = b.consolidate()
+    assert c.to_counter() == {("a", 1): 2, ("b", 2): 1}
+
+
+def test_retraction_cancels():
+    ins = DeltaBatch.from_pairs([("a", 1)])
+    ret = DeltaBatch.from_pairs([("a", 1)], weight=-1)
+    assert DeltaBatch.concat([ins, ret]).consolidate().to_counter() == {}
+
+
+def test_numeric_columns():
+    b = DeltaBatch(np.array([3, 1, 3]), np.array([1.0, 2.0, 3.0]),
+                   np.array([1, 1, -1]))
+    acc = collection_counter([b])
+    assert acc == {(3, 1.0): 1, (1, 2.0): 1, (3, 3.0): -1}
+
+
+def test_spec():
+    s = Spec((768,), np.float32).with_key_space(1000)
+    assert s.key_space == 1000
+    e = DeltaBatch.empty(s)
+    assert e.values.shape == (0, 768)

@@ -1,0 +1,163 @@
+"""Unit tests per op on hand-built delta sequences (SURVEY.md §4a)."""
+
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from reflow_tpu.delta import DeltaBatch
+from reflow_tpu.ops import Filter, GroupBy, Join, Map, Reduce, Union
+
+
+def batch(rows):
+    """rows: list of (key, value, weight)."""
+    return DeltaBatch(
+        np.array([r[0] for r in rows], dtype=object),
+        np.array([r[1] for r in rows], dtype=object),
+        np.array([r[2] for r in rows], dtype=np.int64),
+    )
+
+
+def test_map():
+    op = Map(lambda v: v * 10)
+    out = op.apply(None, [batch([("a", 1, 1), ("b", 2, -1)])])
+    assert out.to_counter() == {("a", 10): 1, ("b", 20): -1}
+
+
+def test_map_vectorized():
+    op = Map(lambda v: v + 1, vectorized=True)
+    b = DeltaBatch(np.array([0, 1]), np.array([1.0, 2.0]), np.array([1, 1]))
+    out = op.apply(None, [b])
+    assert out.to_counter() == {(0, 2.0): 1, (1, 3.0): 1}
+
+
+def test_filter():
+    op = Filter(lambda v: v % 2 == 0)
+    out = op.apply(None, [batch([("a", 1, 1), ("b", 2, 1), ("c", 4, -1)])])
+    assert out.to_counter() == {("b", 2): 1, ("c", 4): -1}
+
+
+def test_groupby_rekeys():
+    op = GroupBy(lambda k, v: v % 3)
+    out = op.apply(None, [batch([("x", 4, 1), ("y", 7, 1), ("z", 5, 1)])])
+    assert out.to_counter() == {(1, 4): 1, (1, 7): 1, (2, 5): 1}
+
+
+def test_reduce_sum_incremental():
+    op = Reduce("sum")
+    st = op.initial_state()
+    out1 = op.apply(st, [batch([("a", 1.0, 1), ("a", 2.0, 1)])])
+    assert out1.to_counter() == {("a", 3.0): 1}
+    # retract one element: aggregate 3 -> 2, emitted as retract+insert
+    out2 = op.apply(st, [batch([("a", 1.0, -1)])])
+    assert out2.to_counter() == {("a", 3.0): -1, ("a", 2.0): 1}
+    # retract the last element: group vanishes
+    out3 = op.apply(st, [batch([("a", 2.0, -1)])])
+    assert out3.to_counter() == {("a", 2.0): -1}
+    assert st == {}
+
+
+def test_reduce_count_weights():
+    op = Reduce("count")
+    st = op.initial_state()
+    out = op.apply(st, [batch([("w", 1, 3), ("w", 1, 2)])])
+    assert out.to_counter() == {("w", 5): 1}
+
+
+def test_reduce_min_retract_nonlinear():
+    op = Reduce("min")
+    st = op.initial_state()
+    op.apply(st, [batch([("a", 5, 1), ("a", 3, 1)])])
+    out = op.apply(st, [batch([("a", 3, -1)])])  # min must climb back to 5
+    assert out.to_counter() == {("a", 3): -1, ("a", 5): 1}
+
+
+def test_reduce_tolerance_suppresses():
+    op = Reduce("sum", tol=1e-6)
+    st = op.initial_state()
+    op.apply(st, [batch([("a", 1.0, 1)])])
+    out = op.apply(st, [batch([("a", 1e-9, 1)])])
+    assert len(out) == 0  # change below tol -> quiescent
+
+
+def test_reduce_tol_drift_retracts_emitted_value():
+    """Regression: tol-suppressed state drift must not corrupt later
+    retractions — the retraction is against the last *emitted* aggregate."""
+    op = Reduce("sum", tol=1e-6)
+    st = op.initial_state()
+    net = Counter()
+    for kv, w in op.apply(st, [batch([("a", 1.0, 1)])]).to_counter().items():
+        net[kv] += w
+    op.apply(st, [batch([("a", 1e-9, 1)])])  # suppressed, state drifts
+    out = op.apply(st, [batch([("a", 1.0, -1), ("a", 1e-9, -1)])])
+    for kv, w in out.to_counter().items():
+        net[kv] += w
+    # group is empty again: all emissions must cancel exactly
+    assert {kv: w for kv, w in net.items() if w != 0} == {}
+    assert st == {}
+
+
+def test_reduce_mixed_sign_multiset_preserved():
+    """Regression: a multiset whose weights net to <= 0 is NOT 'vanished' —
+    negative multiplicities are legal transients of the delta algebra."""
+    op = Reduce("sum")
+    st = op.initial_state()
+    out1 = op.apply(st, [batch([("a", 5.0, -1), ("a", 3.0, 1)])])
+    assert out1.to_counter() == {("a", -2.0): 1}  # 3 - 5
+    out2 = op.apply(st, [batch([("a", 5.0, 1)])])  # cancels the retraction
+    assert out2.to_counter() == {("a", -2.0): -1, ("a", 3.0): 1}
+
+
+def test_join_differential():
+    op = Join()
+    st = op.initial_state()
+    out1 = op.apply(st, [batch([("k", "a1", 1)]), batch([("k", "b1", 1)])])
+    assert out1.to_counter() == {(("k"), ("a1", "b1")): 1}
+    # new left row joins existing right state
+    out2 = op.apply(st, [batch([("k", "a2", 1)]), DeltaBatch.empty()])
+    assert out2.to_counter() == {("k", ("a2", "b1")): 1}
+    # retract right row: both join outputs retract
+    out3 = op.apply(st, [DeltaBatch.empty(), batch([("k", "b1", -1)])])
+    assert out3.to_counter() == {("k", ("a1", "b1")): -1, ("k", ("a2", "b1")): -1}
+
+
+def test_join_merge_fn():
+    op = Join(merge=lambda k, va, vb: va + vb)
+    st = op.initial_state()
+    out = op.apply(st, [batch([("k", 1, 1)]), batch([("k", 10, 1)])])
+    assert out.to_counter() == {("k", 11): 1}
+
+
+def test_union():
+    op = Union(2)
+    out = op.apply(None, [batch([("a", 1, 1)]), batch([("b", 2, -1)])])
+    assert out.to_counter() == {("a", 1): 1, ("b", 2): -1}
+
+
+def test_join_incremental_vs_full_random():
+    """Differential join == full A×B join on the accumulated input."""
+    rng = np.random.default_rng(0)
+    op = Join()
+    st = op.initial_state()
+    acc_a, acc_b, emitted = Counter(), Counter(), Counter()
+    for _ in range(20):
+        da = [(int(rng.integers(3)), int(rng.integers(4)), int(rng.choice([-1, 1])))
+              for _ in range(rng.integers(0, 5))]
+        db = [(int(rng.integers(3)), int(rng.integers(4)), int(rng.choice([-1, 1])))
+              for _ in range(rng.integers(0, 5))]
+        out = op.apply(st, [batch(da) if da else DeltaBatch.empty(),
+                            batch(db) if db else DeltaBatch.empty()])
+        for kv, w in out.to_counter().items():
+            emitted[kv] += w  # NOT Counter.__iadd__, which drops ≤0 entries
+        for k, v, w in da:
+            acc_a[(k, v)] += w
+        for k, v, w in db:
+            acc_b[(k, v)] += w
+    full = Counter()
+    for (ka, va), wa in acc_a.items():
+        for (kb, vb), wb in acc_b.items():
+            if ka == kb and wa and wb:
+                full[(ka, (va, vb))] += wa * wb
+    emitted = Counter({kv: w for kv, w in emitted.items() if w != 0})
+    full = Counter({kv: w for kv, w in full.items() if w != 0})
+    assert emitted == full

@@ -1,0 +1,96 @@
+"""End-to-end config 1 + the incremental-vs-full oracle (SURVEY.md §4b,e)."""
+
+from collections import Counter
+
+import numpy as np
+
+from reflow_tpu import DirtyScheduler
+from reflow_tpu.delta import DeltaBatch
+from reflow_tpu.workloads import wordcount
+
+LINES_T1 = ["the quick brown fox", "jumps over the lazy dog"]
+LINES_T2 = ["the dog barks", "quick quick quick"]
+
+
+def run_incremental(tick_lines):
+    g, src, sink = wordcount.build_graph()
+    sched = DirtyScheduler(g)
+    for lines in tick_lines:
+        sched.push(src, wordcount.ingest_lines(lines))
+        sched.tick()
+    return sched.view_dict(sink)
+
+
+def brute_counts(tick_lines):
+    c = Counter()
+    for lines in tick_lines:
+        for line in lines:
+            c.update(wordcount.tokenize(line))
+    return dict(c)
+
+
+def test_wordcount_two_ticks_matches_brute_force():
+    got = run_incremental([LINES_T1, LINES_T2])
+    assert got == brute_counts([LINES_T1, LINES_T2])
+
+
+def test_incremental_equals_full_recompute():
+    incremental = run_incremental([LINES_T1, LINES_T2])
+    full = run_incremental([LINES_T1 + LINES_T2])
+    assert incremental == full
+
+
+def test_retraction_of_a_line():
+    g, src, sink = wordcount.build_graph()
+    sched = DirtyScheduler(g)
+    sched.push(src, wordcount.ingest_lines(LINES_T1))
+    sched.tick()
+    # retract the first line entirely
+    sched.push(src, wordcount.ingest_lines([LINES_T1[0]], weight=-1))
+    r = sched.tick()
+    assert r.quiesced
+    assert sched.view_dict(sink) == brute_counts([[LINES_T1[1]]])
+
+
+def test_dirty_set_skips_untouched_subgraph():
+    """Only the touched sources' downstream nodes are dirty."""
+    from reflow_tpu.delta import Spec
+    from reflow_tpu.graph import FlowGraph
+    g = FlowGraph()
+    a = g.source("a")
+    b = g.source("b")
+    ma = g.map(a, lambda v: v)
+    mb = g.map(b, lambda v: v)
+    g.sink(ma, "sa")
+    g.sink(mb, "sb")
+    sched = DirtyScheduler(g)
+    sched.push(a, DeltaBatch.from_pairs([("k", 1)]))
+    r = sched.tick()
+    # dirty = a, ma, sa only
+    assert r.dirty_nodes == 3
+
+
+def test_random_delta_oracle():
+    """Property (SURVEY.md §4b): incremental(state, deltas) == full(acc input)
+    for random keyed delta sequences through Map->Reduce."""
+    rng = np.random.default_rng(42)
+    g, src, sink = wordcount.build_graph()
+    sched = DirtyScheduler(g)
+    acc = Counter()
+    words = [f"w{i}" for i in range(20)]
+    for _ in range(30):
+        n = int(rng.integers(1, 8))
+        ks = rng.choice(words, size=n)
+        ws = []
+        for k in ks:
+            # only retract what exists, keeping the multiset valid
+            w = -1 if (acc[k] > 0 and rng.random() < 0.4) else 1
+            acc[k] += w
+            ws.append(w)
+        batch = DeltaBatch(np.array(ks, dtype=object),
+                           np.ones(n, dtype=np.float32),
+                           np.array(ws, dtype=np.int64))
+        sched.push(src, batch)
+        sched.tick()
+    expect = {k: c for k, c in acc.items() if c > 0}
+    assert sched.view_dict(sink) == expect
