@@ -41,9 +41,15 @@ class Spec:
     value_shape: Tuple[int, ...] = ()
     value_dtype: Any = np.float32
     key_space: int = 0  # 0 = unknown / host-only graph
+    #: at most one row per key in the materialized collection (e.g. Reduce
+    #: output). The device Join requires its left input to be unique-keyed.
+    unique: bool = False
 
     def with_key_space(self, n: int) -> "Spec":
         return dataclasses.replace(self, key_space=n)
+
+    def as_unique(self) -> "Spec":
+        return dataclasses.replace(self, unique=True)
 
 
 class DeltaBatch:

@@ -1,0 +1,96 @@
+"""Device-resident delta buffers: padded, columnar, shape-static.
+
+SURVEY.md §2 item 7 (TPU-native equivalent of reflow's Python-object delta
+buffers) and §7 hard part (a): XLA needs static shapes, so device deltas are
+fixed-capacity columns with **weight-0 padding** — a zero-weight row is a
+no-op of the multiset algebra, so every kernel can process all ``capacity``
+slots uniformly with no masking beyond the weights themselves. Padding rows
+carry key 0 so scatter/gather indices stay in range (their weight of 0 makes
+them vanish).
+
+Capacities are bucketed to powers of two to bound jit recompiles
+(§7 hard part (a): recompile-on-capacity-growth, bucketed).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from reflow_tpu.delta import DeltaBatch, Spec
+
+__all__ = ["DeviceDelta", "bucket_capacity", "to_device", "to_host"]
+
+MIN_CAPACITY = 64
+
+
+def bucket_capacity(n: int) -> int:
+    """Next power-of-two capacity ≥ n (min MIN_CAPACITY)."""
+    if n <= MIN_CAPACITY:
+        return MIN_CAPACITY
+    return 1 << (int(n) - 1).bit_length()
+
+
+class DeviceDelta(NamedTuple):
+    """A padded delta batch on device (a jax pytree).
+
+    ``keys``:    int32[C]   — key ids in [0, key_space); 0 on padding rows
+    ``values``:  dtype[C, *value_shape]
+    ``weights``: int32[C]   — 0 marks padding / cancelled rows
+    """
+
+    keys: jax.Array
+    values: jax.Array
+    weights: jax.Array
+
+    @property
+    def capacity(self) -> int:
+        return self.keys.shape[0]
+
+    def nonzero(self) -> jax.Array:
+        """Number of live (weight != 0) rows — device scalar."""
+        return jnp.sum((self.weights != 0).astype(jnp.int32))
+
+    def __len__(self) -> int:  # host-side: forces a scalar readback
+        return int(self.nonzero())
+
+    @staticmethod
+    def empty(spec: Spec, capacity: int = MIN_CAPACITY) -> "DeviceDelta":
+        return DeviceDelta(
+            keys=jnp.zeros((capacity,), jnp.int32),
+            values=jnp.zeros((capacity,) + tuple(spec.value_shape),
+                             spec.value_dtype),
+            weights=jnp.zeros((capacity,), jnp.int32),
+        )
+
+
+def to_device(batch: DeltaBatch, spec: Spec,
+              capacity: Optional[int] = None) -> DeviceDelta:
+    """Host DeltaBatch -> padded DeviceDelta (the source host boundary)."""
+    n = len(batch)
+    cap = capacity if capacity is not None else bucket_capacity(n)
+    if n > cap:
+        raise ValueError(f"batch of {n} rows exceeds capacity {cap}")
+    keys = np.zeros(cap, np.int32)
+    weights = np.zeros(cap, np.int32)
+    values = np.zeros((cap,) + tuple(spec.value_shape), spec.value_dtype)
+    if n:
+        keys[:n] = batch.keys.astype(np.int64)
+        weights[:n] = batch.weights
+        values[:n] = np.asarray(
+            np.stack([np.asarray(v) for v in batch.values])
+            if batch.values.dtype == object else batch.values
+        ).reshape((n,) + tuple(spec.value_shape))
+    return DeviceDelta(jnp.asarray(keys), jnp.asarray(values), jnp.asarray(weights))
+
+
+def to_host(d: DeviceDelta) -> DeltaBatch:
+    """DeviceDelta -> host DeltaBatch, dropping padding (the sink boundary)."""
+    keys = np.asarray(d.keys)
+    values = np.asarray(d.values)
+    weights = np.asarray(d.weights)
+    live = weights != 0
+    return DeltaBatch(keys[live].astype(np.int64), values[live], weights[live])

@@ -1,0 +1,312 @@
+"""Per-op XLA lowerings: one tick pass = pure array code (SURVEY.md §7.7).
+
+Each lowering is a pure function ``(op, node, state, in_deltas) ->
+(out_delta, state')`` over :class:`DeviceDelta` buffers and dense keyed
+state tables. Design rules (tpu-first):
+
+- **No data-dependent shapes.** Emission capacities are static functions of
+  input capacities and key-space sizes; dead rows carry weight 0.
+- **No host round-trips.** Everything here runs inside one ``jax.jit`` step.
+- **NaN hygiene.** Padding rows may hold garbage values; every consumption
+  multiplies through a ``where(w == 0, 0, ...)`` guard so garbage never
+  reaches live state.
+
+Keyed-state representations:
+
+- Reduce (linear reducers sum/count/mean): dense tables over the key space —
+  ``wsum[K,*V]`` (Σ w·v), ``wcnt[K]`` (Σ w), ``emitted[K,*V]`` +
+  ``emitted_has[K]`` (the last aggregate actually emitted downstream, for
+  retract-correctness under ``tol`` — mirrors the host oracle exactly).
+- Join: left side a unique-keyed dense table (``lval[K,*VA]``, ``lw[K]``);
+  right side an append-log arena (``rkeys[R]``, ``rvals[R,*VB]``,
+  ``rw[R]``, ``rcount``). δ(A⋈B) = δA⋈B + (A+δA)⋈δB, with δA split into
+  its retract/insert halves scattered to dense temp tables so the arena-side
+  product is a pure gather (this is the SpMV shape the MXU/VPU wants).
+
+Non-linear reducers (min/max) stay on the CPU oracle path for now; a
+recompute-on-retract device lowering is planned (SURVEY.md §7 hard part c).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from reflow_tpu.delta import Spec
+from reflow_tpu.executors.device_delta import DeviceDelta
+from reflow_tpu.graph import Node
+from reflow_tpu.ops import Filter, GroupBy, Join, Map, Reduce, Union
+
+__all__ = ["lower_node", "reduce_state", "join_state", "DEVICE_REDUCERS"]
+
+DEVICE_REDUCERS = ("sum", "count", "mean")
+
+
+# -- state builders --------------------------------------------------------
+
+def reduce_state(op: Reduce, in_spec: Spec, out_spec: Spec) -> dict:
+    K = in_spec.key_space
+    vshape = tuple(in_spec.value_shape)
+    oshape = tuple(out_spec.value_shape)
+    return {
+        "wsum": jnp.zeros((K,) + vshape, jnp.float32),
+        "wcnt": jnp.zeros((K,), jnp.int32),
+        "emitted": jnp.zeros((K,) + oshape, out_spec.value_dtype),
+        "emitted_has": jnp.zeros((K,), jnp.bool_),
+    }
+
+
+def join_state(op: Join, left_spec: Spec, right_spec: Spec) -> dict:
+    K = left_spec.key_space
+    R = op.arena_capacity
+    return {
+        "lval": jnp.zeros((K,) + tuple(left_spec.value_shape),
+                          left_spec.value_dtype),
+        "lw": jnp.zeros((K,), jnp.int32),
+        "rkeys": jnp.zeros((R,), jnp.int32),
+        "rvals": jnp.zeros((R,) + tuple(right_spec.value_shape),
+                           right_spec.value_dtype),
+        "rw": jnp.zeros((R,), jnp.int32),
+        "rcount": jnp.zeros((), jnp.int32),
+    }
+
+
+# -- helpers ---------------------------------------------------------------
+
+def _bcast_w(w: jax.Array, values: jax.Array) -> jax.Array:
+    """weights [C] broadcast against values [C, *V]."""
+    return w.reshape(w.shape + (1,) * (values.ndim - 1))
+
+
+def _masked_contrib(w: jax.Array, values: jax.Array) -> jax.Array:
+    """w·v with an explicit zero at w==0 so padding NaNs never propagate."""
+    wb = _bcast_w(w, values)
+    return jnp.where(wb == 0, 0, wb.astype(values.dtype) * values)
+
+
+def _differs(a: jax.Array, b: jax.Array, tol: float) -> jax.Array:
+    """Per-key 'aggregates differ' over trailing value axes."""
+    if tol > 0.0:
+        d = jnp.abs(a - b) > tol
+    else:
+        d = a != b
+    if d.ndim > 1:
+        d = jnp.any(d, axis=tuple(range(1, d.ndim)))
+    return d
+
+
+# -- Map / Filter / GroupBy / Union ----------------------------------------
+
+def _apply_rowfn(fn, vectorized: bool, *cols):
+    if vectorized:
+        return fn(*cols)
+    return jax.vmap(fn)(*cols)
+
+
+def _lower_map(op: Map, node: Node, state, ins) -> Tuple[DeviceDelta, None]:
+    (d,) = ins
+    vals = _apply_rowfn(op.fn, op.vectorized, d.values)
+    vals = jnp.asarray(vals, node.spec.value_dtype)
+    return DeviceDelta(d.keys, vals, d.weights), None
+
+
+def _lower_filter(op: Filter, node: Node, state, ins) -> Tuple[DeviceDelta, None]:
+    (d,) = ins
+    keep = _apply_rowfn(op.pred, op.vectorized, d.values)
+    w = jnp.where(jnp.asarray(keep, jnp.bool_), d.weights, 0)
+    return DeviceDelta(d.keys, d.values, w), None
+
+
+def _lower_groupby(op: GroupBy, node: Node, state, ins) -> Tuple[DeviceDelta, None]:
+    (d,) = ins
+    keys = jnp.asarray(
+        _apply_rowfn(op.key_fn, op.vectorized, d.keys, d.values), jnp.int32)
+    # keep padding rows at key 0 so downstream scatters stay in range
+    keys = jnp.where(d.weights == 0, 0, keys)
+    vals = d.values
+    if op.value_fn is not None:
+        vals = jnp.asarray(
+            _apply_rowfn(op.value_fn, op.vectorized, d.keys, d.values),
+            node.spec.value_dtype)
+    return DeviceDelta(keys, vals, d.weights), None
+
+
+def _lower_union(op: Union, node: Node, state, ins) -> Tuple[DeviceDelta, None]:
+    return DeviceDelta(
+        jnp.concatenate([d.keys for d in ins]),
+        jnp.concatenate([d.values for d in ins]),
+        jnp.concatenate([d.weights for d in ins]),
+    ), None
+
+
+# -- Reduce ----------------------------------------------------------------
+
+def _agg_tables(op: Reduce, wsum, wcnt, vdtype):
+    """(aggregate, exists) per key from the running linear tables.
+
+    Existence mirrors the host oracle's linear-observable rule (see
+    ``Reduce._aggregate``): a group exists iff Σw != 0 or Σw·v != 0. For
+    sum with ``tol > 0`` the Σw·v test is tol-guarded, so float scatter-add
+    residue after a full retraction doesn't leave a phantom group behind
+    (with tol == 0 the contract is exact float equality; use a small tol
+    for float workloads on device).
+    """
+    if op.how == "sum":
+        agg = jnp.asarray(wsum, vdtype)
+        nz = jnp.abs(wsum) > op.tol if op.tol > 0.0 else wsum != 0
+        if nz.ndim > 1:
+            nz = jnp.any(nz, axis=tuple(range(1, nz.ndim)))
+        exists = (wcnt != 0) | nz
+    elif op.how == "count":
+        agg = jnp.asarray(wcnt, vdtype)
+        exists = wcnt != 0
+    elif op.how == "mean":
+        denom = jnp.where(wcnt == 0, 1, wcnt)
+        agg = jnp.asarray(wsum / _bcast_w(denom, wsum), vdtype)
+        exists = wcnt != 0
+    else:  # pragma: no cover - validated at bind
+        raise NotImplementedError(op.how)
+    return agg, exists
+
+
+def _lower_reduce(op: Reduce, node: Node, state, ins) -> Tuple[DeviceDelta, dict]:
+    (d,) = ins
+    in_spec = node.inputs[0].spec
+    K = in_spec.key_space
+    C = d.capacity
+    vdtype = node.spec.value_dtype
+
+    wsum = state["wsum"].at[d.keys].add(_masked_contrib(d.weights, d.values))
+    wcnt = state["wcnt"].at[d.keys].add(d.weights)
+    emitted, em_has = state["emitted"], state["emitted_has"]
+
+    if C >= K:
+        # dense mode: diff the whole aggregate table against what was
+        # emitted — no sort, pure vector ops (the PageRank-iteration shape).
+        agg, exists = _agg_tables(op, wsum, wcnt, vdtype)
+        changed = _differs(agg, emitted, op.tol)
+        ins_m = exists & (~em_has | changed)
+        ret_m = em_has & (~exists | changed)
+        all_keys = jnp.arange(K, dtype=jnp.int32)
+        out = DeviceDelta(
+            keys=jnp.concatenate([all_keys, all_keys]),
+            values=jnp.concatenate([emitted, agg]),
+            weights=jnp.concatenate(
+                [-ret_m.astype(jnp.int32), ins_m.astype(jnp.int32)]),
+        )
+        ins_b = _bcast_w(ins_m, agg)
+        new_emitted = jnp.where(ins_b, agg, emitted)
+        new_has = jnp.where(ins_m, True, jnp.where(ret_m & ~exists, False, em_has))
+    else:
+        # sparse mode: sort the touched keys, emit per first occurrence.
+        live = d.weights != 0
+        skey = jnp.where(live, d.keys, K)
+        order = jnp.argsort(skey)
+        sk = skey[order]
+        prev = jnp.concatenate([jnp.full((1,), -1, sk.dtype), sk[:-1]])
+        first = (sk != prev) & (sk < K)
+        tk = jnp.where(sk < K, sk, 0).astype(jnp.int32)
+
+        agg_tab, exists_tab = _agg_tables(op, wsum, wcnt, vdtype)
+        agg = agg_tab[tk]
+        exists = exists_tab[tk]
+        em = emitted[tk]
+        has = em_has[tk]
+        changed = _differs(agg, em, op.tol)
+        ins_m = first & exists & (~has | changed)
+        ret_m = first & has & (~exists | changed)
+        out = DeviceDelta(
+            keys=jnp.concatenate([tk, tk]),
+            values=jnp.concatenate([em, agg]),
+            weights=jnp.concatenate(
+                [-ret_m.astype(jnp.int32), ins_m.astype(jnp.int32)]),
+        )
+        set_ins = jnp.where(ins_m, tk, K)
+        new_emitted = emitted.at[set_ins].set(agg, mode="drop")
+        new_has = em_has.at[set_ins].set(True, mode="drop")
+        set_ret = jnp.where(ret_m & ~exists, tk, K)
+        new_has = new_has.at[set_ret].set(False, mode="drop")
+
+    new_state = {"wsum": wsum, "wcnt": wcnt,
+                 "emitted": new_emitted, "emitted_has": new_has}
+    return out, new_state
+
+
+# -- Join ------------------------------------------------------------------
+
+def _lower_join(op: Join, node: Node, state, ins) -> Tuple[DeviceDelta, dict]:
+    da, db = ins
+    left_spec = node.inputs[0].spec
+    K = left_spec.key_space
+    R = op.arena_capacity
+    odtype = node.spec.value_dtype
+
+    def merge_v(keys, va, vb):
+        out = op.merge(keys, va, vb)
+        return jnp.asarray(out, odtype)
+
+    # split δA into its retract / insert halves, scattered dense
+    wa = da.weights
+    ret_keys = jnp.where(wa < 0, da.keys, K)
+    ins_keys = jnp.where(wa > 0, da.keys, K)
+    zero_val = jnp.zeros((K,) + da.values.shape[1:], da.values.dtype)
+    zero_w = jnp.zeros((K,), jnp.int32)
+    dval_r = zero_val.at[ret_keys].set(da.values, mode="drop")
+    dw_r = zero_w.at[ret_keys].set(wa, mode="drop")
+    dval_i = zero_val.at[ins_keys].set(da.values, mode="drop")
+    dw_i = zero_w.at[ins_keys].set(wa, mode="drop")
+
+    # δA ⋈ B_old : pure gather over the arena (the SpMV)
+    ak, av, aw = state["rkeys"], state["rvals"], state["rw"]
+    outs = []
+    for tab, dw in ((dval_r, dw_r), (dval_i, dw_i)):
+        w = dw[ak] * aw
+        vals = merge_v(ak, tab[ak], av)
+        outs.append(DeviceDelta(ak, vals, w))
+
+    # fold δA into the left table
+    lw = state["lw"].at[da.keys].add(wa)
+    lval = state["lval"].at[ins_keys].set(da.values, mode="drop")
+
+    # (A + δA) ⋈ δB
+    kb, vb, wb = db.keys, db.values, db.weights
+    w = lw[kb] * wb
+    vals = merge_v(kb, lval[kb], vb)
+    outs.append(DeviceDelta(kb, vals, w))
+
+    # append δB to the arena (compacted: live rows first)
+    liveb = wb != 0
+    rank = jnp.cumsum(liveb.astype(jnp.int32)) - 1
+    pos = jnp.where(liveb, state["rcount"] + rank, R)
+    rkeys = ak.at[pos].set(kb, mode="drop")
+    rvals = av.at[pos].set(vb, mode="drop")
+    rw = aw.at[pos].set(wb, mode="drop")
+    rcount = state["rcount"] + jnp.sum(liveb.astype(jnp.int32))
+
+    out = DeviceDelta(
+        jnp.concatenate([o.keys for o in outs]),
+        jnp.concatenate([o.values for o in outs]),
+        jnp.concatenate([o.weights for o in outs]),
+    )
+    new_state = {"lval": lval, "lw": lw, "rkeys": rkeys, "rvals": rvals,
+                 "rw": rw, "rcount": rcount}
+    return out, new_state
+
+
+# -- dispatch --------------------------------------------------------------
+
+_LOWERINGS = {
+    "map": _lower_map,
+    "filter": _lower_filter,
+    "groupby": _lower_groupby,
+    "union": _lower_union,
+    "reduce": _lower_reduce,
+    "join": _lower_join,
+}
+
+
+def lower_node(node: Node, state, ins: Sequence[DeviceDelta]
+               ) -> Tuple[DeviceDelta, Optional[dict]]:
+    return _LOWERINGS[node.op.kind](node.op, node, state, ins)

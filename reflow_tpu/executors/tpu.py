@@ -1,0 +1,189 @@
+"""TpuExecutor: one tick pass = one jit-compiled XLA step.
+
+North star (BASELINE.json): the DirtyScheduler's per-tick batch of
+invalidated nodes is lowered to a single ``jax.jit`` step — vmapped
+Map/Filter, dense segment reductions for GroupBy/Reduce, table×arena
+products for Join — with delta buffers device-resident and host callbacks
+only at graph sources (``to_device``) and sinks (``to_host``). Back-edge
+(loop) deltas stay on device between passes; the only mid-tick readback is
+one scalar liveness count per pass for the scheduler's quiescence check
+(removed entirely by the on-device ``lax.while_loop`` fixpoint path — see
+``fixpoint.py``).
+
+Compiled pass programs are cached per (plan, ingress-capacity-bucket)
+signature, so steady-state ticks hit the cache and pay zero tracing cost.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from reflow_tpu.delta import DeltaBatch
+from reflow_tpu.executors.base import Executor
+from reflow_tpu.executors.device_delta import (DeviceDelta, bucket_capacity,
+                                               to_device, to_host)
+from reflow_tpu.executors.lowerings import (DEVICE_REDUCERS, join_state,
+                                            lower_node, reduce_state)
+from reflow_tpu.graph import FlowGraph, GraphError, Node
+
+__all__ = ["TpuExecutor"]
+
+
+class TpuExecutor(Executor):
+    name = "tpu"
+
+    def __init__(self):
+        super().__init__()
+        self._cache: Dict[tuple, object] = {}
+        self._arena_used: Dict[int, int] = {}  # join node id -> host upper bound
+
+    # -- bind: validate lowerability, build device state -------------------
+
+    def bind(self, graph: FlowGraph) -> None:
+        self.graph = graph
+        self.states = {}
+        # bind() is the re-attach point: compiled passes and arena tracking
+        # close over the old graph's nodes and must not survive a rebind
+        self._cache.clear()
+        self._arena_used.clear()
+        for node in graph.nodes:
+            if node.kind != "op":
+                continue
+            op = node.op
+            if op.kind in ("map", "filter", "groupby", "union"):
+                continue
+            in_specs = [i.spec for i in node.inputs]
+            for s in in_specs:
+                if s.key_space <= 0:
+                    raise GraphError(
+                        f"{node}: TPU lowering needs key_space > 0 on every "
+                        f"keyed-op input Spec")
+            if op.kind == "reduce":
+                if op.how not in DEVICE_REDUCERS:
+                    raise GraphError(
+                        f"{node}: reducer {op.how!r} has no device lowering "
+                        f"yet (have {DEVICE_REDUCERS}); run it on the cpu "
+                        f"executor")
+                self.states[node.id] = reduce_state(op, in_specs[0], node.spec)
+            elif op.kind == "join":
+                if not in_specs[0].unique:
+                    raise GraphError(
+                        f"{node}: device Join requires a unique-keyed left "
+                        f"input (Spec.unique=True, e.g. a Reduce output)")
+                if op.merge is None:
+                    raise GraphError(
+                        f"{node}: device Join requires an explicit "
+                        f"vectorized merge(keys, va, vb) function")
+                self.states[node.id] = join_state(op, in_specs[0], in_specs[1])
+                self._arena_used[node.id] = 0
+            else:
+                raise GraphError(f"{node}: no TPU lowering for {op.kind}")
+
+    # -- one pass ----------------------------------------------------------
+
+    def run_pass(self, plan: Sequence[Node],
+                 ingress: Dict[int, DeltaBatch]) -> Dict[int, object]:
+        nodes_by_id = {n.id: n for n in self.graph.nodes}
+        dev_ingress: Dict[int, DeviceDelta] = {}
+        for nid, b in ingress.items():
+            if isinstance(b, DeviceDelta):
+                dev_ingress[nid] = b
+            else:
+                dev_ingress[nid] = to_device(b, nodes_by_id[nid].spec)
+
+        sig = (
+            tuple(n.id for n in plan),
+            tuple(sorted((nid, d.capacity) for nid, d in dev_ingress.items())),
+        )
+        fn = self._cache.get(sig)
+        if fn is None:
+            fn = self._build(list(plan))
+            self._cache[sig] = fn
+
+        self._track_arena(plan, dev_ingress)  # fail loudly BEFORE truncation
+        op_states = {nid: st for nid, st in self.states.items()}
+        new_states, egress_dev = fn(op_states, dev_ingress)
+        self.states = new_states
+
+        egress: Dict[int, object] = {}
+        sink_ids = {s.id for s in self.graph.sinks}
+        for nid, dd in egress_dev.items():
+            if nid in sink_ids:
+                egress[nid] = to_host(dd).consolidate()
+            else:  # loop back-edge: stays device-resident
+                egress[nid] = dd
+        return egress
+
+    def _track_arena(self, plan, dev_ingress):
+        """Host-side conservative overflow check for Join arenas.
+
+        The append count is data-dependent (on device); we bound it by the
+        right input's capacity and fail loudly *before* silent truncation.
+        """
+        outs_cap: Dict[int, int] = {}
+        for node in plan:
+            if node.kind in ("source", "loop"):
+                if node.id in dev_ingress:
+                    outs_cap[node.id] = dev_ingress[node.id].capacity
+                continue
+            if node.kind == "sink":
+                continue
+            caps = [outs_cap.get(i.id, 0) for i in node.inputs]
+            if all(c == 0 for c in caps):
+                continue
+            if node.op.kind == "join":
+                self._arena_used[node.id] += caps[1]
+                if self._arena_used[node.id] > node.op.arena_capacity:
+                    raise GraphError(
+                        f"{node}: join arena may overflow "
+                        f"({self._arena_used[node.id]} appended rows vs "
+                        f"capacity {node.op.arena_capacity}); raise "
+                        f"arena_capacity")
+                outs_cap[node.id] = 2 * node.op.arena_capacity + caps[1]
+            elif node.op.kind == "reduce":
+                K = node.inputs[0].spec.key_space
+                outs_cap[node.id] = 2 * K if caps[0] >= K else 2 * caps[0]
+            elif node.op.kind == "union":
+                outs_cap[node.id] = sum(caps)
+            else:
+                outs_cap[node.id] = caps[0]
+
+    # -- trace & compile one pass program ----------------------------------
+
+    def _build(self, plan: List[Node]):
+        graph = self.graph
+        sink_inputs = [(s.inputs[0].id, s.id) for s in graph.sinks]
+        back_edges = [(l.back_input.id, l.id) for l in graph.loops
+                      if l.back_input is not None]
+
+        def pass_fn(states, ingress):
+            outs: Dict[int, DeviceDelta] = {}
+            new_states = dict(states)
+            for node in plan:
+                if node.kind in ("source", "loop"):
+                    if node.id in ingress:
+                        outs[node.id] = ingress[node.id]
+                    continue
+                if node.kind == "sink":
+                    continue
+                ins = [outs.get(i.id) for i in node.inputs]
+                if all(x is None for x in ins):
+                    continue
+                ins = [x if x is not None else DeviceDelta.empty(i.spec)
+                       for x, i in zip(ins, node.inputs)]
+                out, st = lower_node(node, new_states.get(node.id), ins)
+                if st is not None:
+                    new_states[node.id] = st
+                outs[node.id] = out
+            egress: Dict[int, DeviceDelta] = {}
+            for src_id, sink_id in sink_inputs:
+                if src_id in outs:
+                    egress[sink_id] = outs[src_id]
+            for back_id, loop_id in back_edges:
+                if back_id in outs:
+                    egress[loop_id] = outs[back_id]
+            return new_states, egress
+
+        return jax.jit(pass_fn)

@@ -157,8 +157,9 @@ class FlowGraph:
         return self.add_op(op, [input], name=name)
 
     def join(self, left: Node, right: Node, merge: Optional[Callable] = None,
-             *, name: Optional[str] = None, spec: Optional[Spec] = None) -> Node:
-        op = Join(merge, out_spec=spec)
+             *, name: Optional[str] = None, spec: Optional[Spec] = None,
+             arena_capacity: int = 1 << 16) -> Node:
+        op = Join(merge, out_spec=spec, arena_capacity=arena_capacity)
         return self.add_op(op, [left, right], name=name)
 
     def union(self, *inputs: Node, name: Optional[str] = None) -> Node:

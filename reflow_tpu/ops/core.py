@@ -15,6 +15,7 @@ Ops are data: the graph stores them; executors interpret or lower them.
 
 from __future__ import annotations
 
+import dataclasses
 import math
 from collections import Counter, defaultdict
 from typing import Any, Callable, Optional, Sequence, Tuple
@@ -116,7 +117,10 @@ class GroupBy(Op):
         self._out_spec = out_spec
 
     def out_spec(self, in_specs):
-        return self._out_spec if self._out_spec is not None else in_specs[0]
+        if self._out_spec is not None:
+            return self._out_spec
+        # re-keying can collapse distinct keys: uniqueness is NOT preserved
+        return dataclasses.replace(in_specs[0], unique=False)
 
     def apply(self, state, in_batches):
         (b,) = in_batches
@@ -210,20 +214,33 @@ class Reduce(Op):
         self._out_spec = out_spec
 
     def out_spec(self, in_specs):
-        return self._out_spec if self._out_spec is not None else in_specs[0]
+        spec = self._out_spec if self._out_spec is not None else in_specs[0]
+        return spec.as_unique()  # one aggregate row per key
 
     def initial_state(self):
         return {}
 
     def _aggregate(self, ms: Counter):
-        """Aggregate of a (possibly mixed-sign) multiset, or _NO_AGG."""
+        """Aggregate of a (possibly mixed-sign) multiset, or _NO_AGG.
+
+        Linear reducers define group existence via their *linear
+        observables* (net count Σw, weighted sum Σw·v): a group whose
+        observables are all zero is indistinguishable from an empty group
+        downstream, so both host and device treat it as vanished. This
+        keeps the cpu-vs-tpu differential contract exact (the device path
+        only keeps the linear observables, never the full multiset).
+        min/max keep true multiset existence (host-only reducers).
+        """
         if not ms:
             return _NO_AGG
         if self.how in ("min", "max"):
             if not any(w > 0 for w in ms.values()):
                 return _NO_AGG
-        elif self.how == "mean":
+        elif self.how in ("mean", "count"):
             if sum(ms.values()) == 0:
+                return _NO_AGG
+        elif self.how == "sum":
+            if sum(ms.values()) == 0 and _agg_sum(ms) == 0:
                 return _NO_AGG
         fn, _ = REDUCERS[self.how]
         return fn(ms)
@@ -280,9 +297,12 @@ class Join(Op):
     arity = 2
 
     def __init__(self, merge: Optional[Callable] = None, *,
-                 out_spec: Optional[Spec] = None):
+                 out_spec: Optional[Spec] = None, arena_capacity: int = 1 << 16):
         self.merge = merge
         self._out_spec = out_spec
+        #: device-path right-side arena capacity (rows); the TPU executor
+        #: stores the right collection as a fixed-size append log.
+        self.arena_capacity = arena_capacity
 
     def out_spec(self, in_specs):
         if self._out_spec is not None:
@@ -334,6 +354,10 @@ class Union(Op):
 
     def __init__(self, arity: int = 2):
         self.arity = arity
+
+    def out_spec(self, in_specs):
+        # merged streams can collide on keys: uniqueness is NOT preserved
+        return dataclasses.replace(in_specs[0], unique=False)
 
     def apply(self, state, in_batches):
         return DeltaBatch.concat(in_batches)

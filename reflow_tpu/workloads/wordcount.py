@@ -34,8 +34,9 @@ def build_graph(key_space: int = 0) -> Tuple[FlowGraph, Node, Node]:
     spec = Spec((), np.float32, key_space=key_space)
     g = FlowGraph("wordcount")
     words = g.source("words", spec)
-    ones = g.map(words, lambda v: np.ones_like(v), vectorized=True,
-                 name="to_ones")
+    # dtype-generic (v*0+1): stays numpy-pure on the CPU oracle and traces
+    # cleanly under jit on device — no jax import on the host-only path
+    ones = g.map(words, lambda v: v * 0 + 1, vectorized=True, name="to_ones")
     counts = g.reduce(ones, "sum", name="counts", spec=spec)
     out = g.sink(counts, "out")
     return g, words, out

@@ -1,0 +1,251 @@
+"""Executor-differential tests (SURVEY.md §4c): CpuExecutor vs TpuExecutor
+on identical graphs and delta sequences. Runs on the CPU JAX platform."""
+
+from collections import Counter
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from reflow_tpu import DirtyScheduler, FlowGraph
+from reflow_tpu.delta import DeltaBatch, Spec
+from reflow_tpu.executors import get_executor
+from reflow_tpu.graph import GraphError
+from reflow_tpu.workloads import wordcount
+
+K = 32
+
+
+def int_batch(rows):
+    """rows: (int_key, float_value, weight)."""
+    return DeltaBatch(
+        np.array([r[0] for r in rows], dtype=np.int64),
+        np.array([r[1] for r in rows], dtype=np.float32),
+        np.array([r[2] for r in rows], dtype=np.int64),
+    )
+
+
+def view_of(sched, sink):
+    return {k: round(float(v), 4) for k, v in sched.view_dict(sink).items()}
+
+
+def both_executors(build, ticks):
+    """Run the same graph + delta sequence on cpu and tpu executors."""
+    views = []
+    for ex in ("cpu", "tpu"):
+        g, srcs, sink = build()
+        sched = DirtyScheduler(g, get_executor(ex))
+        for tick in ticks:
+            for src_name, batch in tick:
+                src = next(s for s in g.sources if s.name == src_name)
+                sched.push(src, batch)
+            sched.tick()
+        views.append(view_of(sched, sink))
+    return views
+
+
+def build_sum_graph():
+    spec = Spec((), np.float32, key_space=K)
+    g = FlowGraph()
+    src = g.source("in", spec)
+    doubled = g.map(src, lambda v: v * 2.0, vectorized=True)
+    total = g.reduce(doubled, "sum", name="sum")
+    sink = g.sink(total, "out")
+    return g, [src], sink
+
+
+def test_map_reduce_sum_differential():
+    ticks = [
+        [("in", int_batch([(1, 1.0, 1), (1, 2.0, 1), (5, 3.0, 1)]))],
+        [("in", int_batch([(1, 1.0, -1), (7, 4.0, 2)]))],
+        [("in", int_batch([(5, 3.0, -1)]))],  # group 5 vanishes
+    ]
+    cpu, tpu = both_executors(build_sum_graph, ticks)
+    assert cpu == tpu == {1: 4.0, 7: 16.0}
+
+
+def test_filter_groupby_differential():
+    def build():
+        spec = Spec((), np.float32, key_space=K)
+        g = FlowGraph()
+        src = g.source("in", spec)
+        big = g.filter(src, lambda v: v > 1.5, vectorized=True)
+        rekey = g.group_by(big, lambda k, v: (k + 1) % K, vectorized=True)
+        total = g.reduce(rekey, "sum", name="sum")
+        sink = g.sink(total, "out")
+        return g, [src], sink
+
+    ticks = [
+        [("in", int_batch([(0, 1.0, 1), (0, 2.0, 1), (3, 9.0, 1)]))],
+        [("in", int_batch([(3, 9.0, -1), (3, 5.0, 1)]))],
+    ]
+    cpu, tpu = both_executors(build, ticks)
+    assert cpu == tpu == {1: 2.0, 4: 5.0}
+
+
+def test_reduce_count_and_mean_differential():
+    for how, expect in (("count", {2: 3.0}), ("mean", {2: 2.0})):
+        def build(how=how):
+            spec = Spec((), np.float32, key_space=K)
+            g = FlowGraph()
+            src = g.source("in", spec)
+            agg = g.reduce(src, how, name="agg")
+            sink = g.sink(agg, "out")
+            return g, [src], sink
+
+        ticks = [
+            [("in", int_batch([(2, 1.0, 1), (2, 2.0, 1)]))],
+            [("in", int_batch([(2, 3.0, 1)]))],
+        ]
+        cpu, tpu = both_executors(build, ticks)
+        assert cpu == tpu == expect, how
+
+
+def test_join_differential_pagerank_shape():
+    """Unique-keyed table (left) ⋈ growing arena (right), merge = product."""
+    def build():
+        vspec = Spec((), np.float32, key_space=K, unique=True)
+        g = FlowGraph()
+        vals = g.source("vals", vspec)     # unique per key (like ranks)
+        edges = g.source("edges", Spec((), np.float32, key_space=K))
+        tot = g.reduce(vals, "sum", name="uniq")   # makes left unique-keyed
+        j = g.join(tot, edges, merge=lambda k, va, vb: va * vb,
+                   spec=Spec((), np.float32, key_space=K), arena_capacity=256)
+        out = g.reduce(j, "sum", name="joined")
+        sink = g.sink(out, "out")
+        return g, [vals, edges], sink
+
+    ticks = [
+        [("vals", int_batch([(1, 10.0, 1), (2, 20.0, 1)])),
+         ("edges", int_batch([(1, 0.5, 1), (1, 0.25, 1), (2, 1.0, 1)]))],
+        # change a left value: 10 -> 11 (retract+insert via source)
+        [("vals", int_batch([(1, 10.0, -1), (1, 11.0, 1)]))],
+        # add and retract edges
+        [("edges", int_batch([(2, 2.0, 1), (1, 0.5, -1)]))],
+    ]
+    cpu, tpu = both_executors(build, ticks)
+    # key1: 11*0.25 = 2.75 ; key2: 20*1 + 20*2 = 60
+    assert cpu == tpu == {1: 2.75, 2: 60.0}
+
+
+def test_wordcount_differential():
+    texts = [["the quick brown fox", "the lazy dog"],
+             ["quick quick dog"],
+             []]
+    vocab_cpu: dict = {}
+    vocab_tpu: dict = {}
+    views = []
+    for ex, vocab in (("cpu", vocab_cpu), ("tpu", vocab_tpu)):
+        g, src, sink = wordcount.build_graph(key_space=64)
+        sched = DirtyScheduler(g, get_executor(ex))
+        for lines in texts:
+            batch = wordcount.ingest_lines(lines, vocab=vocab)
+            if len(batch):
+                sched.push(src, batch)
+            sched.tick()
+        views.append(view_of(sched, sink))
+    assert vocab_cpu == vocab_tpu
+    assert views[0] == views[1]
+    assert views[0][vocab_cpu["quick"]] == 3.0
+
+
+def test_tpu_rejects_unkeyed_spec():
+    g = FlowGraph()
+    src = g.source("in", Spec())  # key_space 0
+    g.sink(g.reduce(src, "sum"), "out")
+    with pytest.raises(GraphError, match="key_space"):
+        DirtyScheduler(g, get_executor("tpu"))
+
+
+def test_tpu_rejects_minmax_reducer():
+    g = FlowGraph()
+    src = g.source("in", Spec((), np.float32, key_space=8))
+    g.sink(g.reduce(src, "min"), "out")
+    with pytest.raises(GraphError, match="no device lowering"):
+        DirtyScheduler(g, get_executor("tpu"))
+
+
+def test_tpu_join_requires_unique_left():
+    spec = Spec((), np.float32, key_space=8)
+    g = FlowGraph()
+    a = g.source("a", spec)
+    b = g.source("b", spec)
+    g.sink(g.join(a, b, merge=lambda k, x, y: x + y, spec=spec), "out")
+    with pytest.raises(GraphError, match="unique-keyed"):
+        DirtyScheduler(g, get_executor("tpu"))
+
+
+def test_groupby_clears_unique_flag():
+    """Regression: re-keying can collapse keys, so the device Join's
+    unique-left check must reject a GroupBy output."""
+    spec = Spec((), np.float32, key_space=8)
+    g = FlowGraph()
+    a = g.source("a", spec)
+    b = g.source("b", spec)
+    u = g.reduce(a, "sum")          # unique=True here
+    grouped = g.group_by(u, lambda k, v: k // 2, vectorized=True)
+    assert not grouped.spec.unique
+    g.sink(g.join(grouped, b, merge=lambda k, x, y: x + y, spec=spec), "out")
+    with pytest.raises(GraphError, match="unique-keyed"):
+        DirtyScheduler(g, get_executor("tpu"))
+
+
+def test_rebind_clears_compiled_cache():
+    """Regression: rebinding the same executor to a different graph must not
+    replay pass programs compiled for the old graph."""
+    ex = get_executor("tpu")
+    g1, _, _ = build_sum_graph()
+    s1 = DirtyScheduler(g1, ex)
+    src1 = g1.sources[0]
+    s1.push(src1, int_batch([(1, 1.0, 1)]))
+    s1.tick()
+    assert len(ex._cache) == 1
+
+    def build_negated():
+        spec = Spec((), np.float32, key_space=K)
+        g = FlowGraph()
+        src = g.source("in", spec)
+        neg = g.map(src, lambda v: -v, vectorized=True)
+        total = g.reduce(neg, "sum", name="sum")
+        sink = g.sink(total, "out")
+        return g, [src], sink
+
+    g2, (src2,), sink2 = build_negated()
+    s2 = DirtyScheduler(g2, ex)  # rebind same executor instance
+    s2.push(src2, int_batch([(1, 1.0, 1)]))
+    s2.tick()
+    assert view_of(s2, sink2) == {1: -1.0}  # not the old graph's v*2
+
+
+def test_full_retraction_leaves_no_phantom_group():
+    """Regression: float scatter-add residue must not resurrect a fully
+    retracted group when tol > 0 (device) — host is exact."""
+    def build():
+        spec = Spec((), np.float32, key_space=8)
+        g = FlowGraph()
+        src = g.source("in", spec)
+        agg = g.reduce(src, "sum", tol=1e-5)
+        sink = g.sink(agg, "out")
+        return g, [src], sink
+
+    ticks = [
+        [("in", int_batch([(3, 0.1, 1), (3, 0.2, 1)]))],
+        [("in", int_batch([(3, 0.1, -1), (3, 0.2, -1)]))],
+    ]
+    cpu, tpu = both_executors(build, ticks)
+    assert cpu == tpu == {}
+
+
+def test_tpu_reduce_tol_quiesces():
+    spec = Spec((), np.float32, key_space=8)
+    g = FlowGraph()
+    src = g.source("in", spec)
+    agg = g.reduce(src, "sum", tol=1e-3)
+    sink = g.sink(agg, "out")
+    sched = DirtyScheduler(g, get_executor("tpu"))
+    sched.push(src, int_batch([(1, 1.0, 1)]))
+    r1 = sched.tick()
+    assert len(r1.sink_deltas["out"]) == 1
+    sched.push(src, int_batch([(1, 1e-6, 1)]))
+    r2 = sched.tick()
+    assert r2.sink_deltas == {} or len(r2.sink_deltas.get("out", [])) == 0
