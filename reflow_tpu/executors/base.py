@@ -45,6 +45,31 @@ class Executor(abc.ABC):
                  ingress: Dict[int, DeltaBatch]) -> Dict[int, DeltaBatch]:
         ...
 
+    def materialize(self, batch) -> DeltaBatch:
+        """Convert a (possibly device-resident) sink egress batch to host."""
+        return batch
+
+    def read_table(self, node: Node) -> Dict:
+        """Materialized {key: value} of a stateful node's collection.
+
+        Reduce: the last emitted aggregate per key. Join: the left table.
+        """
+        st = self.states.get(node.id)
+        if st is None:
+            raise KeyError(f"{node} holds no materialized state")
+        if node.op.kind == "reduce":
+            from reflow_tpu.ops.core import _NO_AGG
+            return {k: em for k, (ms, em) in st.items() if em is not _NO_AGG}
+        if node.op.kind == "join":
+            left, _right = st
+            out = {}
+            for k, ms in left.items():
+                for v, w in ms.items():
+                    if w > 0:
+                        out[k] = v
+            return out
+        raise KeyError(f"{node} ({node.op.kind}) has no table to read")
+
     # -- checkpoint seam (SURVEY.md §5) -----------------------------------
 
     def state_snapshot(self) -> Dict[int, object]:

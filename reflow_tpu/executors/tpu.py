@@ -107,14 +107,35 @@ class TpuExecutor(Executor):
         new_states, egress_dev = fn(op_states, dev_ingress)
         self.states = new_states
 
-        egress: Dict[int, object] = {}
-        sink_ids = {s.id for s in self.graph.sinks}
-        for nid, dd in egress_dev.items():
-            if nid in sink_ids:
-                egress[nid] = to_host(dd).consolidate()
-            else:  # loop back-edge: stays device-resident
-                egress[nid] = dd
-        return egress
+        # everything stays device-resident: sink batches are materialized
+        # lazily by the scheduler once per tick, loop back-edges feed the
+        # next pass directly on device
+        return dict(egress_dev)
+
+    def materialize(self, batch) -> DeltaBatch:
+        if isinstance(batch, DeviceDelta):
+            return to_host(batch)
+        return batch
+
+    def read_table(self, node: Node):
+        import numpy as np
+
+        st = self.states.get(node.id)
+        if st is None:
+            raise KeyError(f"{node} holds no materialized state")
+        if node.op.kind == "reduce":
+            has = np.asarray(st["emitted_has"])
+            vals = np.asarray(st["emitted"])
+            keys = np.nonzero(has)[0]
+            return {int(k): vals[k] if vals.ndim > 1 else vals[k].item()
+                    for k in keys}
+        if node.op.kind == "join":
+            lw = np.asarray(st["lw"])
+            lval = np.asarray(st["lval"])
+            keys = np.nonzero(lw > 0)[0]
+            return {int(k): lval[k] if lval.ndim > 1 else lval[k].item()
+                    for k in keys}
+        raise KeyError(f"{node} ({node.op.kind}) has no table to read")
 
     def _track_arena(self, plan, dev_ingress):
         """Host-side conservative overflow check for Join arenas.

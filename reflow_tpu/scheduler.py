@@ -64,8 +64,10 @@ class DirtyScheduler:
     # -- host boundary in --------------------------------------------------
 
     def push(self, source: Node, batch: DeltaBatch) -> None:
-        if source.kind != "source":
-            raise GraphError(f"can only push to sources, not {source}")
+        """Buffer deltas at a source — or at a loop variable, which is how a
+        fixpoint computation receives its initial condition."""
+        if source.kind not in ("source", "loop"):
+            raise GraphError(f"can only push to sources/loops, not {source}")
         if len(batch):
             self._pending[source.id].append(batch)
 
@@ -121,7 +123,10 @@ class DirtyScheduler:
 
         out: Dict[str, DeltaBatch] = {}
         for name, batches in sink_deltas.items():
-            merged = DeltaBatch.concat(batches).consolidate()
+            # sink batches may still be device-resident (deferred readback:
+            # the host crossing happens once per tick, not once per pass)
+            merged = DeltaBatch.concat(
+                [self.executor.materialize(b) for b in batches]).consolidate()
             out[name] = merged
             deltas_out += len(merged)
             view = self.sink_views[name]
@@ -145,6 +150,14 @@ class DirtyScheduler:
         return result
 
     # -- host boundary out -------------------------------------------------
+
+    def read_table(self, node: Node) -> Dict:
+        """Materialized {key: value} of a stateful node's collection at the
+        tick boundary (Reduce: last emitted aggregates; Join: the left
+        table). This is the sink-style host crossing for collections that
+        live inside loop regions, where a per-pass delta sink would force
+        mid-tick readbacks."""
+        return self.executor.read_table(node)
 
     def view(self, sink: str | Node) -> Counter:
         """Materialized multiset {(key, value): weight} at a sink."""
