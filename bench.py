@@ -1,0 +1,133 @@
+#!/usr/bin/env python3
+"""Benchmark harness: incremental PageRank (BASELINE.md config 3).
+
+Runs the north-star workload — incremental PageRank under per-tick edge
+churn — on the TpuExecutor at full scale and on the CpuExecutor (the
+default path / baseline), and prints ONE JSON line to stdout::
+
+    {"metric": ..., "value": <speedup>, "unit": "x", "vs_baseline": <v/20>}
+
+``value`` is the delta-ops/sec throughput ratio TPU/CPU on the churn ticks
+(the "delta-ops/sec/chip + incremental-vs-full speedup" metric from
+BASELINE.md; the 20x divisor is the BASELINE.json north-star target).
+Detail (per-executor build/tick walls, incremental-vs-full speedup) goes to
+stderr.
+
+Env knobs::
+
+    REFLOW_BENCH_SMOKE=1          tiny scale (local sanity check)
+    REFLOW_BENCH_NODES/EDGES      graph size        (default 100k / 1M)
+    REFLOW_BENCH_CHURN            churn fraction    (default 0.01)
+    REFLOW_BENCH_TICKS            measured ticks    (default 3)
+    REFLOW_BENCH_CPU_EDGES_CAP    CPU run is scaled down to at most this
+                                  many edges (Python-loop baseline; its
+                                  per-row throughput is scale-independent)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a) -> None:
+    print(*a, file=sys.stderr, flush=True)
+
+
+def run_pagerank(executor: str, n_nodes: int, n_edges: int, churn: float,
+                 ticks: int, tol: float) -> dict:
+    from reflow_tpu.executors import get_executor
+    from reflow_tpu.scheduler import DirtyScheduler
+    from reflow_tpu.workloads import pagerank
+
+    # the executor's conservative overflow tracker counts padded ingress
+    # *capacities* (power-of-two bucketed), so size the arena in those terms
+    from reflow_tpu.executors.device_delta import bucket_capacity
+    churn_cap = bucket_capacity(2 * int(churn * n_edges) + 2)
+    # 2x the full-edge capacity: the warm full-recompute baseline rebuilds
+    # the graph once more on the same executor (same arena tracker)
+    arena = 2 * bucket_capacity(n_edges) + (ticks + 3) * churn_cap
+    pr = pagerank.build_graph(n_nodes, tol=tol, arena_capacity=arena)
+    sched = DirtyScheduler(pr.graph, get_executor(executor))
+    web = pagerank.WebGraph.random(n_nodes, n_edges, seed=7)
+
+    sched.push(pr.teleport, pagerank.teleport_batch(n_nodes))
+    sched.push(pr.edges, web.initial_batch())
+    t0 = time.perf_counter()
+    sched.tick()
+    build_s = time.perf_counter() - t0
+
+    # one unmeasured churn tick to absorb jit compiles of the churn shapes
+    sched.push(pr.edges, web.churn(churn))
+    sched.tick()
+
+    walls, dops = [], []
+    for _ in range(ticks):
+        sched.push(pr.edges, web.churn(churn))
+        res = sched.tick()
+        walls.append(res.wall_s)
+        dops.append(res.delta_ops)
+
+    # warm full-recompute baseline: rebuild from scratch on the same (warm)
+    # executor, so jit compile time isn't billed to "full recompute"
+    ex = sched.executor
+    sched2 = DirtyScheduler(pr.graph, ex)
+    sched2.push(pr.teleport, pagerank.teleport_batch(n_nodes))
+    sched2.push(pr.edges, web.initial_batch())
+    t0 = time.perf_counter()
+    sched2.tick()
+    full_s = time.perf_counter() - t0
+
+    return {
+        "executor": executor,
+        "nodes": n_nodes,
+        "edges": n_edges,
+        "cold_build_s": build_s,
+        "full_recompute_s": full_s,
+        "tick_s_median": float(np.median(walls)),
+        "delta_ops_per_s": float(sum(dops) / sum(walls)),
+        "delta_ops_per_tick": float(np.mean(dops)),
+    }
+
+
+def main() -> None:
+    smoke = os.environ.get("REFLOW_BENCH_SMOKE") == "1"
+    n_nodes = int(os.environ.get(
+        "REFLOW_BENCH_NODES", 1_000 if smoke else 100_000))
+    n_edges = int(os.environ.get(
+        "REFLOW_BENCH_EDGES", 10_000 if smoke else 1_000_000))
+    churn = float(os.environ.get("REFLOW_BENCH_CHURN", 0.01))
+    ticks = int(os.environ.get("REFLOW_BENCH_TICKS", 2 if smoke else 3))
+    cpu_cap = int(os.environ.get(
+        "REFLOW_BENCH_CPU_EDGES_CAP", 10_000 if smoke else 100_000))
+    tol = 1e-4
+
+    import jax
+    log(f"jax backend={jax.default_backend()} devices={len(jax.devices())}")
+
+    tpu = run_pagerank("tpu", n_nodes, n_edges, churn, ticks, tol)
+    log("tpu:", json.dumps(tpu))
+    incr_vs_full = tpu["full_recompute_s"] / tpu["tick_s_median"]
+    log(f"incremental-vs-full (tpu executor, warm): {incr_vs_full:.1f}x")
+
+    scale = min(1.0, cpu_cap / n_edges)
+    cpu = run_pagerank("cpu", max(64, int(n_nodes * scale)),
+                       max(256, int(n_edges * scale)), churn,
+                       max(1, min(ticks, 2)), tol)
+    log("cpu:", json.dumps(cpu))
+
+    speedup = tpu["delta_ops_per_s"] / cpu["delta_ops_per_s"]
+    print(json.dumps({
+        "metric": "pagerank_incremental_delta_ops_per_s_speedup_vs_cpu_executor",
+        "value": round(speedup, 2),
+        "unit": "x",
+        "vs_baseline": round(speedup / 20.0, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

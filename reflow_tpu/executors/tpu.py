@@ -42,11 +42,13 @@ class TpuExecutor(Executor):
     # -- bind: validate lowerability, build device state -------------------
 
     def bind(self, graph: FlowGraph) -> None:
+        # compiled passes close over graph nodes: rebinding the *same* graph
+        # (fresh state, e.g. a full-recompute baseline) keeps the jit cache;
+        # a different graph invalidates it
+        if graph is not self.graph:
+            self._cache.clear()
         self.graph = graph
         self.states = {}
-        # bind() is the re-attach point: compiled passes and arena tracking
-        # close over the old graph's nodes and must not survive a rebind
-        self._cache.clear()
         self._arena_used.clear()
         for node in graph.nodes:
             if node.kind != "op":
@@ -174,6 +176,13 @@ class TpuExecutor(Executor):
     # -- trace & compile one pass program ----------------------------------
 
     def _build(self, plan: List[Node]):
+        return jax.jit(self.build_pass_fn(plan))
+
+    def build_pass_fn(self, plan: List[Node]):
+        """The pure, jittable pass program: ``(states, ingress) -> (states',
+        egress)`` over DeviceDelta pytrees. Exposed un-jitted so callers
+        (``__graft_entry__``, the sharded executor) can wrap it with their
+        own ``jax.jit`` / sharding annotations."""
         graph = self.graph
         sink_inputs = [(s.inputs[0].id, s.id) for s in graph.sinks]
         back_edges = [(l.back_input.id, l.id) for l in graph.loops
@@ -207,4 +216,4 @@ class TpuExecutor(Executor):
                     egress[loop_id] = outs[back_id]
             return new_states, egress
 
-        return jax.jit(pass_fn)
+        return pass_fn

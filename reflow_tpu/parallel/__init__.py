@@ -1,0 +1,23 @@
+"""Mesh + sharding layer (SURVEY.md §2 parallelism checklist, §7.8).
+
+The parallelism strategies native to this framework class:
+
+- **delta-parallel (dp analog)**: delta buffers sharded along their row
+  (capacity) axis over the mesh — each chip ingests a slice of the tick's
+  changes.
+- **key-parallel (tp analog)**: keyed state tables (Reduce aggregates, Join
+  left tables) sharded along the key axis — each chip owns a key range;
+  cross-shard combination is ``psum``/``all_to_all`` key routing.
+- **topo-partitioning (pp analog)**: FlowGraph stages placed on mesh
+  sub-axes (Node.stage).
+
+This package provides the mesh construction + NamedSharding placement
+helpers shared by the sharded executor, ``__graft_entry__.dryrun_multichip``
+and the benchmark harness.
+"""
+
+from reflow_tpu.parallel.mesh import (DELTA_AXIS, make_mesh, replicate,
+                                      shard_delta, shard_state_tree)
+
+__all__ = ["DELTA_AXIS", "make_mesh", "replicate", "shard_delta",
+           "shard_state_tree"]

@@ -1,0 +1,79 @@
+"""Mesh construction and NamedSharding placement for delta buffers + state.
+
+Design (tpu-first): the mesh has one primary ``delta`` axis. Delta buffers
+shard along their row axis (each chip processes a slice of the tick's
+changes); keyed state tables shard along the key axis (each chip owns a key
+range). Under ``jax.jit`` the GSPMD partitioner inserts the collectives the
+north star names — scatter-adds into a key-sharded Reduce table become
+on-chip partial sums + ``psum``-style combines; re-keying (GroupBy) becomes
+``all_to_all`` key routing. The explicit ``shard_map`` lowering (for ops
+XLA shouldn't re-derive, e.g. the Join arena product) lives in
+``parallel/shard.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["DELTA_AXIS", "make_mesh", "shard_delta", "shard_state_tree",
+           "replicate"]
+
+#: name of the mesh axis delta rows and key ranges are sharded over
+DELTA_AXIS = "delta"
+
+
+def make_mesh(n_devices: Optional[int] = None, *,
+              axis_name: str = DELTA_AXIS) -> Mesh:
+    """A 1-D device mesh over the first ``n_devices`` local devices.
+
+    On real hardware the device order jax reports follows the ICI torus, so
+    a 1-D mesh keeps neighbor collectives on ICI links.
+    """
+    devs = jax.devices()
+    n = len(devs) if n_devices is None else n_devices
+    if len(devs) < n:
+        raise ValueError(
+            f"need {n} devices, have {len(devs)} "
+            f"(set XLA_FLAGS=--xla_force_host_platform_device_count={n} "
+            f"JAX_PLATFORMS=cpu for a virtual mesh)")
+    return Mesh(np.array(devs[:n]), (axis_name,))
+
+
+def _dim0_sharding(mesh: Mesh, axis_name: str, x) -> NamedSharding:
+    """Shard dim 0 if it divides the mesh axis; replicate otherwise.
+
+    Scalars (Join's ``rcount``) and ragged dims stay replicated — a
+    conservative, always-correct placement.
+    """
+    n = mesh.shape[axis_name]
+    if getattr(x, "ndim", 0) >= 1 and x.shape[0] % n == 0:
+        return NamedSharding(mesh, P(axis_name))
+    return NamedSharding(mesh, P())
+
+
+def shard_delta(delta, mesh: Mesh, *, axis_name: str = DELTA_AXIS):
+    """Place a DeviceDelta's columns row-sharded over the mesh (dp analog)."""
+    return jax.tree.map(
+        lambda x: jax.device_put(x, _dim0_sharding(mesh, axis_name, x)), delta)
+
+
+def shard_state_tree(states, mesh: Mesh, *, axis_name: str = DELTA_AXIS):
+    """Place per-node state tables key-sharded over the mesh (tp analog).
+
+    Every leaf whose dim 0 divides the mesh shards along it (Reduce tables
+    along the key space, Join arenas along the append log); odd-shaped and
+    scalar leaves replicate.
+    """
+    return jax.tree.map(
+        lambda x: jax.device_put(x, _dim0_sharding(mesh, axis_name, x)),
+        states)
+
+
+def replicate(tree, mesh: Mesh):
+    """Fully replicate a pytree over the mesh."""
+    sh = NamedSharding(mesh, P())
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)

@@ -1,12 +1,22 @@
-"""Test env: force an 8-device virtual CPU mesh before jax import.
+"""Test env: force an 8-device virtual CPU mesh before jax backend init.
 
 SURVEY.md §4d: mesh/collective/topo-partition tests run on CPU in CI via
 ``xla_force_host_platform_device_count`` — no TPU hardware required.
+
+Note: this environment exports ``JAX_PLATFORMS=axon`` (a live TPU tunnel)
+and the axon plugin wins platform selection even when that env var is
+overridden, so the platform must also be forced through ``jax.config``,
+which works as long as it runs before first backend use.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
