@@ -45,6 +45,17 @@ class Executor(abc.ABC):
                  ingress: Dict[int, DeltaBatch]) -> Dict[int, DeltaBatch]:
         ...
 
+    def run_tick_fixpoint(self, plan: Sequence[Node],
+                          ingress: Dict[int, DeltaBatch], max_iters: int):
+        """Optionally run an ENTIRE tick (all fixpoint passes) in one call.
+
+        Returns ``({sink_id: [batches]}, passes, loop_rows, quiesced,
+        extra_dirty_node_ids)`` or None when unsupported — the scheduler
+        then drives passes itself. Executors that can fuse the loop on
+        device (TpuExecutor via ``lax.while_loop``) override this.
+        """
+        return None
+
     def materialize(self, batch) -> DeltaBatch:
         """Convert a (possibly device-resident) sink egress batch to host."""
         return batch

@@ -34,10 +34,15 @@ __all__ = ["TpuExecutor"]
 class TpuExecutor(Executor):
     name = "tpu"
 
-    def __init__(self):
+    def __init__(self, *, fixpoint: bool = True):
         super().__init__()
         self._cache: Dict[tuple, object] = {}
         self._arena_used: Dict[int, int] = {}  # join node id -> host upper bound
+        #: lower whole ticks of iterative graphs to one lax.while_loop
+        #: program (False forces the host-driven per-pass loop)
+        self.fixpoint = fixpoint
+        self._fx_structure = None
+        self._fx_unsupported = not fixpoint
 
     # -- bind: validate lowerability, build device state -------------------
 
@@ -47,6 +52,8 @@ class TpuExecutor(Executor):
         # a different graph invalidates it
         if graph is not self.graph:
             self._cache.clear()
+            self._fx_structure = None
+            self._fx_unsupported = not self.fixpoint
         self.graph = graph
         self.states = {}
         self._arena_used.clear()
@@ -85,15 +92,19 @@ class TpuExecutor(Executor):
 
     # -- one pass ----------------------------------------------------------
 
-    def run_pass(self, plan: Sequence[Node],
-                 ingress: Dict[int, DeltaBatch]) -> Dict[int, object]:
-        nodes_by_id = {n.id: n for n in self.graph.nodes}
+    def _to_device_ingress(self, ingress) -> Dict[int, DeviceDelta]:
+        """Host boundary in: upload host batches; pass device ones through."""
         dev_ingress: Dict[int, DeviceDelta] = {}
         for nid, b in ingress.items():
             if isinstance(b, DeviceDelta):
                 dev_ingress[nid] = b
             else:
-                dev_ingress[nid] = to_device(b, nodes_by_id[nid].spec)
+                dev_ingress[nid] = to_device(b, self.graph.nodes[nid].spec)
+        return dev_ingress
+
+    def run_pass(self, plan: Sequence[Node],
+                 ingress: Dict[int, DeltaBatch]) -> Dict[int, object]:
+        dev_ingress = self._to_device_ingress(ingress)
 
         sig = (
             tuple(n.id for n in plan),
@@ -104,7 +115,9 @@ class TpuExecutor(Executor):
             fn = self._build(list(plan))
             self._cache[sig] = fn
 
-        self._track_arena(plan, dev_ingress)  # fail loudly BEFORE truncation
+        # fail loudly BEFORE truncation
+        self._track_arena(plan, {nid: d.capacity
+                                 for nid, d in dev_ingress.items()})
         op_states = {nid: st for nid, st in self.states.items()}
         new_states, egress_dev = fn(op_states, dev_ingress)
         self.states = new_states
@@ -113,6 +126,59 @@ class TpuExecutor(Executor):
         # lazily by the scheduler once per tick, loop back-edges feed the
         # next pass directly on device
         return dict(egress_dev)
+
+    # -- whole-tick on-device fixpoint (SURVEY.md §7.9, hard part e) -------
+
+    def run_tick_fixpoint(self, plan: Sequence[Node],
+                          ingress: Dict[int, DeltaBatch], max_iters: int):
+        """Run an entire tick (initial pass + fixpoint + exit pass) as one
+        compiled program. Returns ``(sink_batches, passes, loop_rows,
+        quiesced)`` or None when the graph doesn't fit the on-device
+        structure (the scheduler then uses its host-driven loop)."""
+        from reflow_tpu.executors.fixpoint import FixpointProgram, analyze
+
+        if self._fx_unsupported:
+            return None
+        if self._fx_structure is None:
+            self._fx_structure = analyze(self.graph)
+            if self._fx_structure is None:
+                self._fx_unsupported = True
+                return None
+
+        dev_ingress = self._to_device_ingress(ingress)
+        caps = {nid: d.capacity for nid, d in dev_ingress.items()}
+
+        sig = ("fx", tuple(n.id for n in plan),
+               tuple(sorted(caps.items())), max_iters)
+        prog = self._cache.get(sig)
+        if prog is None:
+            try:
+                prog = FixpointProgram(self, plan, caps, max_iters,
+                                       structure=self._fx_structure)
+            except ValueError:
+                self._fx_unsupported = True
+                return None
+            self._cache[sig] = prog
+
+        st = self._fx_structure
+        self._track_arena(plan, caps)
+        if st.exit_plan:
+            self._track_arena(
+                list(st.exit_plan),
+                {n.id: 2 * n.inputs[0].spec.key_space for n in st.boundary})
+
+        new_states, sink_egress, iters, rows, converged = prog(
+            dict(self.states), dev_ingress)
+        self.states = new_states
+        iters = int(iters)
+        passes = 1 + iters + (1 if st.exit_plan else 0)
+        # nodes the fused passes executed beyond the phase-A plan (for the
+        # scheduler's dirty-set observability): region + exit nodes, which
+        # only ran if the loop actually iterated
+        extra_dirty = (set(st.region_ids) | {n.id for n in st.exit_plan}
+                       if iters > 0 else set())
+        return ({sid: list(batches) for sid, batches in sink_egress.items()},
+                passes, int(rows), bool(converged), extra_dirty)
 
     def materialize(self, batch) -> DeltaBatch:
         if isinstance(batch, DeviceDelta):
@@ -139,17 +205,17 @@ class TpuExecutor(Executor):
                     for k in keys}
         raise KeyError(f"{node} ({node.op.kind}) has no table to read")
 
-    def _track_arena(self, plan, dev_ingress):
+    def _track_arena(self, plan, ingress_caps: Dict[int, int]):
         """Host-side conservative overflow check for Join arenas.
 
         The append count is data-dependent (on device); we bound it by the
         right input's capacity and fail loudly *before* silent truncation.
+        ``ingress_caps`` maps seeded node ids (sources, loops, fixpoint
+        boundary producers) to their delta capacities.
         """
-        outs_cap: Dict[int, int] = {}
+        outs_cap: Dict[int, int] = dict(ingress_caps)
         for node in plan:
-            if node.kind in ("source", "loop"):
-                if node.id in dev_ingress:
-                    outs_cap[node.id] = dev_ingress[node.id].capacity
+            if node.kind in ("source", "loop") or node.id in ingress_caps:
                 continue
             if node.kind == "sink":
                 continue
@@ -189,12 +255,14 @@ class TpuExecutor(Executor):
                       if l.back_input is not None]
 
         def pass_fn(states, ingress):
-            outs: Dict[int, DeviceDelta] = {}
+            # ingress seeds *any* node's output (sources/loops in the normal
+            # tick; boundary producers in the fixpoint exit pass; stage
+            # boundaries under topo-partitioning) — seeded nodes are not
+            # recomputed
+            outs: Dict[int, DeviceDelta] = dict(ingress)
             new_states = dict(states)
             for node in plan:
-                if node.kind in ("source", "loop"):
-                    if node.id in ingress:
-                        outs[node.id] = ingress[node.id]
+                if node.id in outs or node.kind in ("source", "loop"):
                     continue
                 if node.kind == "sink":
                     continue

@@ -110,6 +110,20 @@ class DirtyScheduler:
                 break
             plan = self._dirty_plan(list(ingress))
             dirty_union.update(n.id for n in plan)
+            if passes == 0 and self.graph.loops:
+                # iterative graph: let the executor fuse the entire tick
+                # (all fixpoint passes) into one on-device program
+                fx = self.executor.run_tick_fixpoint(
+                    plan, ingress, self.max_loop_iters)
+                if fx is not None:
+                    (sink_batches, fx_passes, loop_rows, quiesced,
+                     extra_dirty) = fx
+                    passes = fx_passes
+                    deltas_in += loop_rows
+                    dirty_union.update(extra_dirty)
+                    for sid, batches in sink_batches.items():
+                        sink_deltas[sink_ids[sid].name].extend(batches)
+                    break
             egress = self.executor.run_pass(plan, ingress)
             passes += 1
             ingress = {}
