@@ -24,5 +24,16 @@ def _lazy_tpu():
     return TpuExecutor
 
 
+def _lazy_sharded():
+    try:
+        from reflow_tpu.parallel.shard import ShardedTpuExecutor  # noqa: F401
+    except ImportError as e:
+        raise NotImplementedError(
+            "the 'sharded' executor requires jax "
+            f"(import failed: {e})") from e
+    return ShardedTpuExecutor
+
+
 register_executor("cpu", CpuExecutor)
 register_executor("tpu", _lazy_tpu)
+register_executor("sharded", _lazy_sharded)

@@ -39,7 +39,8 @@ from reflow_tpu.executors.device_delta import DeviceDelta
 from reflow_tpu.graph import Node
 from reflow_tpu.ops import Filter, GroupBy, Join, Map, Reduce, Union
 
-__all__ = ["lower_node", "reduce_state", "join_state", "DEVICE_REDUCERS"]
+__all__ = ["lower_node", "reduce_state", "join_state", "join_core",
+           "DEVICE_REDUCERS"]
 
 DEVICE_REDUCERS = ("sum", "count", "mean")
 
@@ -239,12 +240,23 @@ def _lower_reduce(op: Reduce, node: Node, state, ins) -> Tuple[DeviceDelta, dict
 def _lower_join(op: Join, node: Node, state, ins) -> Tuple[DeviceDelta, dict]:
     da, db = ins
     left_spec = node.inputs[0].spec
-    K = left_spec.key_space
-    R = op.arena_capacity
-    odtype = node.spec.value_dtype
+    return join_core(op, left_spec.key_space, op.arena_capacity,
+                     node.spec.value_dtype, state, da, db)
+
+
+def join_core(op: Join, K: int, R: int, odtype, state,
+              da: DeviceDelta, db: DeviceDelta,
+              key_offset=0) -> Tuple[DeviceDelta, dict]:
+    """The join kernel over a (possibly per-shard) key range.
+
+    ``da``/``db`` carry keys LOCAL to this range ``[0, K)``;
+    ``key_offset`` maps them back to global ids on emitted rows and in the
+    arguments handed to ``merge`` (the sharded path passes the shard base;
+    single-device passes 0).
+    """
 
     def merge_v(keys, va, vb):
-        out = op.merge(keys, va, vb)
+        out = op.merge(keys + key_offset, va, vb)
         return jnp.asarray(out, odtype)
 
     # split δA into its retract / insert halves, scattered dense
@@ -264,7 +276,7 @@ def _lower_join(op: Join, node: Node, state, ins) -> Tuple[DeviceDelta, dict]:
     for tab, dw in ((dval_r, dw_r), (dval_i, dw_i)):
         w = dw[ak] * aw
         vals = merge_v(ak, tab[ak], av)
-        outs.append(DeviceDelta(ak, vals, w))
+        outs.append(DeviceDelta(ak + key_offset, vals, w))
 
     # fold δA into the left table
     lw = state["lw"].at[da.keys].add(wa)
@@ -274,7 +286,7 @@ def _lower_join(op: Join, node: Node, state, ins) -> Tuple[DeviceDelta, dict]:
     kb, vb, wb = db.keys, db.values, db.weights
     w = lw[kb] * wb
     vals = merge_v(kb, lval[kb], vb)
-    outs.append(DeviceDelta(kb, vals, w))
+    outs.append(DeviceDelta(kb + key_offset, vals, w))
 
     # append δB to the arena (compacted: live rows first)
     liveb = wb != 0

@@ -43,6 +43,9 @@ class TpuExecutor(Executor):
         self.fixpoint = fixpoint
         self._fx_structure = None
         self._fx_unsupported = not fixpoint
+        #: mesh size for sharded subclasses: arena overflow is bounded
+        #: against the per-shard slice (worst-case key skew)
+        self._arena_divisor = 1
 
     # -- bind: validate lowerability, build device state -------------------
 
@@ -223,14 +226,18 @@ class TpuExecutor(Executor):
             if all(c == 0 for c in caps):
                 continue
             if node.op.kind == "join":
+                cap = node.op.arena_capacity // self._arena_divisor
                 self._arena_used[node.id] += caps[1]
-                if self._arena_used[node.id] > node.op.arena_capacity:
+                if self._arena_used[node.id] > cap:
                     raise GraphError(
                         f"{node}: join arena may overflow "
                         f"({self._arena_used[node.id]} appended rows vs "
-                        f"capacity {node.op.arena_capacity}); raise "
-                        f"arena_capacity")
-                outs_cap[node.id] = 2 * node.op.arena_capacity + caps[1]
+                        f"per-shard capacity {cap}); raise arena_capacity")
+                # sharded: each of the n shards emits 2*R/n + caps[1] rows
+                # (the right delta is all_gather'd), so global egress is
+                # 2*R + n*caps[1]
+                outs_cap[node.id] = (2 * node.op.arena_capacity +
+                                     self._arena_divisor * caps[1])
             elif node.op.kind == "reduce":
                 K = node.inputs[0].spec.key_space
                 outs_cap[node.id] = 2 * K if caps[0] >= K else 2 * caps[0]
@@ -240,6 +247,11 @@ class TpuExecutor(Executor):
                 outs_cap[node.id] = caps[0]
 
     # -- trace & compile one pass program ----------------------------------
+
+    def _lower(self, node: Node, state, ins):
+        """Per-node lowering hook (sharded subclass swaps in shard-aware
+        keyed-op kernels; the pass traversal itself is shared)."""
+        return lower_node(node, state, ins)
 
     def _build(self, plan: List[Node]):
         return jax.jit(self.build_pass_fn(plan))
@@ -271,7 +283,7 @@ class TpuExecutor(Executor):
                     continue
                 ins = [x if x is not None else DeviceDelta.empty(i.spec)
                        for x, i in zip(ins, node.inputs)]
-                out, st = lower_node(node, new_states.get(node.id), ins)
+                out, st = self._lower(node, new_states.get(node.id), ins)
                 if st is not None:
                     new_states[node.id] = st
                 outs[node.id] = out

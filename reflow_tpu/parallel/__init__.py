@@ -17,7 +17,6 @@ and the benchmark harness.
 """
 
 from reflow_tpu.parallel.mesh import (DELTA_AXIS, make_mesh, replicate,
-                                      shard_delta, shard_state_tree)
+                                      shard_state_tree)
 
-__all__ = ["DELTA_AXIS", "make_mesh", "replicate", "shard_delta",
-           "shard_state_tree"]
+__all__ = ["DELTA_AXIS", "make_mesh", "replicate", "shard_state_tree"]

@@ -19,8 +19,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["DELTA_AXIS", "make_mesh", "shard_delta", "shard_state_tree",
-           "replicate"]
+__all__ = ["DELTA_AXIS", "make_mesh", "shard_state_tree", "replicate"]
 
 #: name of the mesh axis delta rows and key ranges are sharded over
 DELTA_AXIS = "delta"
@@ -53,12 +52,6 @@ def _dim0_sharding(mesh: Mesh, axis_name: str, x) -> NamedSharding:
     if getattr(x, "ndim", 0) >= 1 and x.shape[0] % n == 0:
         return NamedSharding(mesh, P(axis_name))
     return NamedSharding(mesh, P())
-
-
-def shard_delta(delta, mesh: Mesh, *, axis_name: str = DELTA_AXIS):
-    """Place a DeviceDelta's columns row-sharded over the mesh (dp analog)."""
-    return jax.tree.map(
-        lambda x: jax.device_put(x, _dim0_sharding(mesh, axis_name, x)), delta)
 
 
 def shard_state_tree(states, mesh: Mesh, *, axis_name: str = DELTA_AXIS):

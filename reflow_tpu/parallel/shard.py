@@ -1,0 +1,116 @@
+"""ShardedTpuExecutor: the tick pass as an explicit SPMD program.
+
+SURVEY.md §7.8 / north star: delta buffers row-sharded over the mesh, keyed
+state tables key-range-sharded, cross-shard combines as explicit
+collectives (``psum_scatter`` in Reduce, ``all_gather`` key-routing in
+Join) under ``jax.shard_map``. Composes with the on-device fixpoint
+unchanged: ``build_pass_fn`` keeps the global ``(states, ingress) ->
+(states', egress)`` signature, so ``FixpointProgram`` wraps the shard_map'd
+pass in its ``lax.while_loop`` exactly like the single-device one.
+
+Divisibility contract (validated at bind): the mesh size must be a power
+of two no larger than the minimum delta capacity (so every bucketed delta
+capacity is a multiple of it), and every keyed op's ``key_space`` and
+every Join's ``arena_capacity`` must be multiples of the mesh size.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from reflow_tpu.executors.device_delta import MIN_CAPACITY, DeviceDelta
+from reflow_tpu.executors.tpu import TpuExecutor
+from reflow_tpu.graph import FlowGraph, GraphError, Node
+from reflow_tpu.parallel.mesh import make_mesh, shard_state_tree
+from reflow_tpu.parallel.shard_lowerings import lower_node_sharded
+
+__all__ = ["ShardedTpuExecutor"]
+
+
+class ShardedTpuExecutor(TpuExecutor):
+    name = "sharded"
+
+    def __init__(self, mesh: Optional[Mesh] = None, *, fixpoint: bool = True):
+        super().__init__(fixpoint=fixpoint)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.axis = self.mesh.axis_names[0]
+        self.n = self.mesh.shape[self.axis]
+        if self.n & (self.n - 1) or self.n > MIN_CAPACITY:
+            raise GraphError(
+                f"mesh size {self.n} must be a power of two <= "
+                f"{MIN_CAPACITY} so bucketed delta capacities shard evenly")
+        self._arena_divisor = self.n
+
+    # -- bind: divisibility validation + sharded state placement -----------
+
+    def bind(self, graph: FlowGraph) -> None:
+        super().bind(graph)
+        n = self.n
+        for node in graph.nodes:
+            if node.kind != "op" or node.op.kind not in ("reduce", "join"):
+                continue
+            K = node.inputs[0].spec.key_space
+            if K % n:
+                raise GraphError(
+                    f"{node}: key_space {K} must be a multiple of the mesh "
+                    f"size {n} (round it up)")
+            if node.op.kind == "join":
+                if node.op.arena_capacity % n:
+                    raise GraphError(
+                        f"{node}: arena_capacity {node.op.arena_capacity} "
+                        f"must be a multiple of the mesh size {n}")
+                # per-shard append counters (one scalar per mesh slot)
+                self.states[node.id]["rcount"] = jnp.zeros((n,), jnp.int32)
+        self.states = shard_state_tree(self.states, self.mesh,
+                                       axis_name=self.axis)
+
+    def _state_spec(self, x) -> P:
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] % self.n == 0:
+            return P(self.axis)
+        return P()
+
+    # -- the SPMD pass program ---------------------------------------------
+
+    def _lower(self, node: Node, state, ins):
+        return lower_node_sharded(node, state, ins, self.axis, self.n)
+
+    def build_pass_fn(self, plan: List[Node]):
+        graph = self.graph
+        mesh, axis = self.mesh, self.axis
+        # the shared traversal from TpuExecutor (with this class's _lower
+        # hook) becomes the per-shard body under shard_map
+        local_pass = super().build_pass_fn(plan)
+        sink_inputs = [(s.inputs[0].id, s.id) for s in graph.sinks]
+        back_edges = [(l.back_input.id, l.id) for l in graph.loops
+                      if l.back_input is not None]
+        dspec = DeviceDelta(P(axis), P(axis), P(axis))
+
+        def _egress_ids(ingress_ids):
+            # mirror of the traversal's reachability, capacities aside
+            outs = set(ingress_ids)
+            for node in plan:
+                if (node.id in outs or
+                        node.kind in ("source", "loop", "sink")):
+                    continue
+                if any(i.id in outs for i in node.inputs):
+                    outs.add(node.id)
+            eg = [sid for src, sid in sink_inputs if src in outs]
+            eg += [lid for bid, lid in back_edges if bid in outs]
+            return eg
+
+        def pass_fn(states, ingress):
+            # ingress structure is static at trace time: derive the
+            # shard_map partitioning specs for exactly this signature
+            state_specs = jax.tree.map(self._state_spec, states)
+            in_specs = (state_specs, {nid: dspec for nid in ingress})
+            out_specs = (state_specs, {eid: dspec
+                                       for eid in _egress_ids(ingress)})
+            fn = jax.shard_map(local_pass, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, check_vma=False)
+            return fn(states, ingress)
+
+        return pass_fn

@@ -1,0 +1,126 @@
+"""Shard-aware op lowerings: the per-shard kernels under ``shard_map``.
+
+Design (the scaling-book recipe — gather what's small, shard what's big):
+
+- **Map / Filter / GroupBy / Union** are local on row-sharded delta
+  buffers: no communication. A GroupBy re-key leaves rows in place; routing
+  happens where a *keyed* op consumes them.
+- **Reduce**: each shard scatter-adds its local delta rows into a full-K
+  contribution table, then one ``psum_scatter`` (reduce-scatter over the
+  mesh axis) hands every shard the combined contributions for its owned
+  key range — the cross-shard combine the north star names. State tables
+  (``wsum``/``wcnt``/``emitted``) live key-sharded; emission covers the
+  owned range with global key ids.
+- **Join**: per-tick deltas are small, per-key state is big — so both
+  delta sides are ``all_gather``'d (tiled), masked to the shard's owned
+  key range, localized, and fed to the shared :func:`join_core` over the
+  shard's slice of the left table and append arena. Output rows stay on
+  the owning shard (row-sharded), keys global.
+
+Keyed state is range-sharded: shard ``i`` of ``n`` owns keys
+``[i*K/n, (i+1)*K/n)``. Range (not hash) sharding keeps key<->shard
+arithmetic trivial and lets emission use a contiguous ``arange``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from reflow_tpu.executors.device_delta import DeviceDelta
+from reflow_tpu.executors.lowerings import (_LOWERINGS, _agg_tables,
+                                            _bcast_w, _differs,
+                                            _masked_contrib, join_core)
+from reflow_tpu.graph import Node
+
+__all__ = ["lower_node_sharded"]
+
+
+def _localize(d: DeviceDelta, base, Kl: int) -> DeviceDelta:
+    """Mask a gathered delta to this shard's key range and re-base keys.
+
+    Non-owned rows become weight-0 padding at local key 0 — no-ops of the
+    multiset algebra, so the downstream kernel needs no other masking.
+    """
+    own = (d.keys >= base) & (d.keys < base + Kl)
+    return DeviceDelta(
+        keys=jnp.where(own, d.keys - base, 0),
+        values=d.values,
+        weights=jnp.where(own, d.weights, 0),
+    )
+
+
+def _lower_reduce_sharded(op, node: Node, state, ins, axis: str, n: int
+                          ) -> Tuple[DeviceDelta, dict]:
+    (d,) = ins                      # local delta rows [Cl]
+    in_spec = node.inputs[0].spec
+    K = in_spec.key_space
+    Kl = K // n
+    vdtype = node.spec.value_dtype
+    base = (jax.lax.axis_index(axis) * Kl).astype(jnp.int32)
+
+    # local full-K contributions, then reduce-scatter to the owned range
+    vshape = d.values.shape[1:]
+    contrib = jnp.zeros((K,) + vshape, jnp.float32).at[d.keys].add(
+        _masked_contrib(d.weights, d.values).astype(jnp.float32))
+    cnt = jnp.zeros((K,), jnp.int32).at[d.keys].add(d.weights)
+    wsum = state["wsum"] + jax.lax.psum_scatter(
+        contrib, axis, scatter_dimension=0, tiled=True)
+    wcnt = state["wcnt"] + jax.lax.psum_scatter(
+        cnt, axis, scatter_dimension=0, tiled=True)
+
+    # dense diff over the owned slice (mirrors _lower_reduce dense mode)
+    emitted, em_has = state["emitted"], state["emitted_has"]
+    agg, exists = _agg_tables(op, wsum, wcnt, vdtype)
+    changed = _differs(agg, emitted, op.tol)
+    ins_m = exists & (~em_has | changed)
+    ret_m = em_has & (~exists | changed)
+    gkeys = base + jnp.arange(Kl, dtype=jnp.int32)
+    out = DeviceDelta(
+        keys=jnp.concatenate([gkeys, gkeys]),
+        values=jnp.concatenate([emitted, agg]),
+        weights=jnp.concatenate(
+            [-ret_m.astype(jnp.int32), ins_m.astype(jnp.int32)]),
+    )
+    ins_b = _bcast_w(ins_m, agg)
+    new_emitted = jnp.where(ins_b, agg, emitted)
+    new_has = jnp.where(ins_m, True, jnp.where(ret_m & ~exists, False, em_has))
+    return out, {"wsum": wsum, "wcnt": wcnt,
+                 "emitted": new_emitted, "emitted_has": new_has}
+
+
+def _lower_join_sharded(op, node: Node, state, ins, axis: str, n: int
+                        ) -> Tuple[DeviceDelta, dict]:
+    da, db = ins                    # local delta rows
+    K = node.inputs[0].spec.key_space
+    Kl = K // n
+    Rl = op.arena_capacity // n
+    base = (jax.lax.axis_index(axis) * Kl).astype(jnp.int32)
+
+    # deltas are small: gather both sides everywhere, keep only owned rows
+    da_g = jax.tree.map(lambda x: jax.lax.all_gather(x, axis, tiled=True), da)
+    db_g = jax.tree.map(lambda x: jax.lax.all_gather(x, axis, tiled=True), db)
+    da_l = _localize(da_g, base, Kl)
+    db_l = _localize(db_g, base, Kl)
+
+    # per-shard scalar append counter is stored as a length-1 slice of a
+    # mesh-length vector; the core kernel wants a scalar
+    core_state = dict(state)
+    core_state["rcount"] = state["rcount"][0]
+    out, new_state = join_core(op, Kl, Rl, node.spec.value_dtype,
+                               core_state, da_l, db_l, key_offset=base)
+    new_state["rcount"] = new_state["rcount"][None]
+    return out, new_state
+
+
+def lower_node_sharded(node: Node, state, ins: Sequence[DeviceDelta],
+                       axis: str, n: int) -> Tuple[DeviceDelta, dict]:
+    kind = node.op.kind
+    if kind == "reduce":
+        return _lower_reduce_sharded(node.op, node, state, ins, axis, n)
+    if kind == "join":
+        return _lower_join_sharded(node.op, node, state, ins, axis, n)
+    # stateless row ops are shard-local
+    return _LOWERINGS[kind](node.op, node, state, ins)

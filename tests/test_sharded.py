@@ -1,0 +1,133 @@
+"""ShardedTpuExecutor on the 8-device virtual CPU mesh (SURVEY.md §4d):
+collectives (psum_scatter, all_gather) + key-range sharding, differential
+against the single-device TpuExecutor and the CPU oracle."""
+
+import numpy as np
+import pytest
+
+from reflow_tpu import DeltaBatch, DirtyScheduler, FlowGraph, Spec
+from reflow_tpu.executors import CpuExecutor
+from reflow_tpu.executors.tpu import TpuExecutor
+from reflow_tpu.parallel import make_mesh
+from reflow_tpu.parallel.shard import ShardedTpuExecutor
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8)
+
+
+def _reduce_graph(K=64):
+    spec = Spec((), np.float32, key_space=K)
+    g = FlowGraph("wc")
+    src = g.source("src", spec)
+    ones = g.map(src, lambda v: v * 0 + 1, vectorized=True, name="ones")
+    counts = g.reduce(ones, "sum", name="counts",
+                      spec=Spec((), np.float32, key_space=K))
+    out = g.sink(counts, "out")
+    return g, src, out
+
+
+def _push_ticks(sched, src, rng, K, ticks=3):
+    views = []
+    for t in range(ticks):
+        n = 50 + 30 * t
+        keys = rng.integers(0, K, n)
+        w = np.where(rng.random(n) < 0.25, -1, 1)
+        sched.push(src, DeltaBatch(keys, np.ones(n, np.float32), w))
+        sched.tick()
+        views.append(dict(sched.view_dict("out")))
+    return views
+
+
+def test_sharded_reduce_matches_cpu(mesh):
+    K = 64
+    g1, s1, _ = _reduce_graph(K)
+    g2, s2, _ = _reduce_graph(K)
+    sh = DirtyScheduler(g1, ShardedTpuExecutor(mesh))
+    cp = DirtyScheduler(g2, CpuExecutor())
+    v_sh = _push_ticks(sh, s1, np.random.default_rng(0), K)
+    v_cp = _push_ticks(cp, s2, np.random.default_rng(0), K)
+    for a, b in zip(v_sh, v_cp):
+        assert {int(k): float(v) for k, v in a.items()} == \
+               {int(k): float(v) for k, v in b.items()}
+
+
+def test_sharded_pagerank_matches_single_device(mesh):
+    from reflow_tpu.workloads import pagerank
+
+    N, E = 64, 512
+    ref_ranks = {}
+    for ex in (ShardedTpuExecutor(mesh), TpuExecutor()):
+        web = pagerank.WebGraph.random(N, E, seed=11)
+        pg = pagerank.build_graph(N, tol=1e-5, arena_capacity=1 << 13)
+        sched = DirtyScheduler(pg.graph, ex, max_loop_iters=500)
+        sched.push(pg.teleport, pagerank.teleport_batch(N))
+        sched.push(pg.edges, web.initial_batch())
+        r = sched.tick()
+        assert r.quiesced
+        for _ in range(2):
+            sched.push(pg.edges, web.churn(0.05))
+            assert sched.tick().quiesced
+        ref_ranks[ex.name] = sched.read_table(pg.new_rank)
+        ref = pagerank.reference_ranks(web)
+
+    a, b = ref_ranks["sharded"], ref_ranks["tpu"]
+    assert set(a) == set(b)
+    for k in a:
+        assert abs(float(a[k]) - float(b[k])) < 1e-4
+    # and both match the NumPy oracle on the churned graph
+    arr = np.full(N, 1.0 - pagerank.DAMPING)
+    for k, v in a.items():
+        arr[int(k)] = float(v)
+    np.testing.assert_allclose(arr, ref, atol=5e-4)
+
+
+def test_sharded_join_matches_cpu(mesh):
+    K = 32
+    left_spec = Spec((), np.float32, key_space=K, unique=True)
+    right_spec = Spec((), np.float32, key_space=K)
+
+    def build():
+        g = FlowGraph("j")
+        a = g.source("a", left_spec)
+        b = g.source("b", right_spec)
+        j = g.join(a, b, merge=lambda k, va, vb: va * 10 + vb,
+                   spec=right_spec, name="j", arena_capacity=1 << 10)
+        out = g.sink(j, "out")
+        return g, a, b
+
+    ga, a1, b1 = build()
+    gb, a2, b2 = build()
+    sh = DirtyScheduler(ga, ShardedTpuExecutor(mesh))
+    cp = DirtyScheduler(gb, CpuExecutor())
+
+    def drive(sched, a, b):
+        rng = np.random.default_rng(5)
+        ka = rng.permutation(K)[:16]
+        sched.push(a, DeltaBatch(ka, ka.astype(np.float32)))
+        kb = rng.integers(0, K, 40)
+        sched.push(b, DeltaBatch(kb, np.ones(40, np.float32)))
+        sched.tick()
+        # retract some right rows, add more left keys next tick
+        sched.push(b, DeltaBatch(kb[:10], np.ones(10, np.float32),
+                                 -np.ones(10, np.int64)))
+        sched.tick()
+        return {kv: w for kv, w in sched.view("out").items()}
+
+    va = drive(sh, a1, b1)
+    # CPU merge gets scalar args; device merge gets arrays — same formula
+    vb = drive(cp, a2, b2)
+    norm = lambda d: {(int(k), float(v)): int(w) for (k, v), w in d.items()}
+    assert norm(va) == norm(vb)
+
+
+def test_key_space_divisibility_enforced(mesh):
+    g = FlowGraph("bad")
+    src = g.source("s", Spec((), np.float32, key_space=30))
+    r = g.reduce(src, "sum", spec=Spec((), np.float32, key_space=30))
+    g.sink(r, "out")
+    from reflow_tpu.graph import GraphError
+
+    with pytest.raises(GraphError, match="multiple of the mesh"):
+        DirtyScheduler(g, ShardedTpuExecutor(mesh))
