@@ -79,6 +79,8 @@ class Executor(abc.ABC):
                     if w > 0:
                         out[k] = v
             return out
+        if node.op.kind == "knn":
+            return dict(st["emitted"])
         raise KeyError(f"{node} ({node.op.kind}) has no table to read")
 
     # -- checkpoint seam (SURVEY.md §5) -----------------------------------

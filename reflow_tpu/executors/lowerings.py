@@ -40,7 +40,7 @@ from reflow_tpu.graph import Node
 from reflow_tpu.ops import Filter, GroupBy, Join, Map, Reduce, Union
 
 __all__ = ["lower_node", "reduce_state", "join_state", "join_core",
-           "DEVICE_REDUCERS"]
+           "knn_state", "DEVICE_REDUCERS"]
 
 DEVICE_REDUCERS = ("sum", "count", "mean")
 
@@ -307,6 +307,105 @@ def join_core(op: Join, K: int, R: int, odtype, state,
     return out, new_state
 
 
+# -- KnnIndex (SURVEY.md §2 item 14: vmapped cosine + Pallas top-k) --------
+
+def knn_state(op, q_spec: Spec, d_spec: Spec) -> dict:
+    Q, D = q_spec.key_space, d_spec.key_space
+    dim, k = op.dim, op.k
+    return {
+        "qvec": jnp.zeros((Q, dim), jnp.float32),
+        "qlive": jnp.zeros((Q,), jnp.bool_),
+        "dvec": jnp.zeros((D, dim), jnp.float32),
+        "dlive": jnp.zeros((D,), jnp.bool_),
+        "emitted": jnp.zeros((Q, k, 2), jnp.float32),
+        "em_has": jnp.zeros((Q,), jnp.bool_),
+    }
+
+
+def _norm_rows(v):
+    n = jnp.sqrt(jnp.sum(v * v, axis=-1, keepdims=True))
+    return jnp.where(n > 0, v / jnp.maximum(n, 1e-30), 0.0)
+
+
+def _fold_vectors(vec, live, delta):
+    """Retract-then-insert fold of vector deltas into a dense table (an
+    in-tick update = retract + insert resolves to the insert)."""
+    C = delta.capacity
+    cap = vec.shape[0]
+    ins = jnp.where(delta.weights > 0, delta.keys, cap)
+    ret = jnp.where(delta.weights < 0, delta.keys, cap)
+    vals = _norm_rows(jnp.asarray(delta.values, jnp.float32))
+    vec = vec.at[ins].set(vals, mode="drop")
+    live = live.at[ret].set(False, mode="drop").at[ins].set(True, mode="drop")
+    return vec, live
+
+
+def _lower_knn(op, node: Node, state, ins) -> Tuple[DeviceDelta, dict]:
+    from reflow_tpu.kernels.topk import NEG, chunked_corpus_topk, topk
+
+    dq, dd = ins
+    Q = node.inputs[0].spec.key_space
+    D = node.inputs[1].spec.key_space
+    k = op.k
+
+    qvec, qlive = _fold_vectors(state["qvec"], state["qlive"], dq)
+    dvec, dlive = _fold_vectors(state["dvec"], state["dlive"], dd)
+    emitted, em_has = state["emitted"], state["em_has"]
+    prec = (jax.lax.Precision.HIGHEST if op.precision == "highest"
+            else jax.lax.Precision.DEFAULT)
+
+    # doc-insert and query-retract ticks take the incremental merge (a
+    # retracted query just stops emitting); query inserts/updates or doc
+    # retractions rescan the corpus (chunked, MXU)
+    need_full = jnp.any(dd.weights < 0) | jnp.any(dq.weights > 0)
+
+    def full_path(_):
+        return chunked_corpus_topk(qvec, dvec, dlive, k, op.scan_chunk,
+                                   precision=prec)
+
+    def incr_path(_):
+        # current top-k rows stay valid (no retractions): merge them with
+        # scores against just the delta docs
+        em_ids = emitted[:, :, 0].astype(jnp.int32)            # [Q, k]
+        em_vals = jnp.where(em_has[:, None] & (em_ids >= 0),
+                            emitted[:, :, 1], NEG)
+        di = dd.keys                                           # [Cd]
+        s_new = jnp.dot(qvec, dvec[di].T,
+                        preferred_element_type=jnp.float32,
+                        precision=prec)                        # [Q, Cd]
+        s_new = jnp.where((dd.weights > 0)[None, :], s_new, NEG)
+        cand_vals = jnp.concatenate([em_vals, s_new], axis=1)
+        cand_ids = jnp.concatenate(
+            [em_ids, jnp.broadcast_to(di, (Q, di.shape[0]))], axis=1)
+        # order candidates by id so topk's first-index tie-break matches
+        # the oracle's lowest-doc-id rule on exact score ties
+        order = jnp.argsort(cand_ids, axis=1, stable=True)
+        cand_ids = jnp.take_along_axis(cand_ids, order, axis=1)
+        cand_vals = jnp.take_along_axis(cand_vals, order, axis=1)
+        vals, sel = topk(cand_vals, k)
+        ids = jnp.take_along_axis(cand_ids, sel, axis=1)
+        return vals, ids
+
+    vals, ids = jax.lax.cond(need_full, full_path, incr_path, None)
+    ids = jnp.where(vals <= NEG, -1, ids)
+    new_row = jnp.stack([ids.astype(jnp.float32), vals], axis=-1)  # [Q,k,2]
+
+    changed = jnp.any(new_row != emitted, axis=(1, 2))
+    ins_m = qlive & (~em_has | changed)
+    ret_m = em_has & (~qlive | changed)
+    qkeys = jnp.arange(Q, dtype=jnp.int32)
+    out = DeviceDelta(
+        keys=jnp.concatenate([qkeys, qkeys]),
+        values=jnp.concatenate([emitted, new_row]),
+        weights=jnp.concatenate(
+            [-ret_m.astype(jnp.int32), ins_m.astype(jnp.int32)]),
+    )
+    new_emitted = jnp.where(ins_m[:, None, None], new_row, emitted)
+    new_has = jnp.where(ins_m, True, jnp.where(ret_m & ~qlive, False, em_has))
+    return out, {"qvec": qvec, "qlive": qlive, "dvec": dvec, "dlive": dlive,
+                 "emitted": new_emitted, "em_has": new_has}
+
+
 # -- dispatch --------------------------------------------------------------
 
 _LOWERINGS = {
@@ -316,6 +415,7 @@ _LOWERINGS = {
     "union": _lower_union,
     "reduce": _lower_reduce,
     "join": _lower_join,
+    "knn": _lower_knn,
 }
 
 

@@ -79,6 +79,19 @@ class TpuExecutor(Executor):
                         f"yet (have {DEVICE_REDUCERS}); run it on the cpu "
                         f"executor")
                 self.states[node.id] = reduce_state(op, in_specs[0], node.spec)
+            elif op.kind == "knn":
+                for port, s in enumerate(in_specs):
+                    if tuple(s.value_shape) != (op.dim,):
+                        raise GraphError(
+                            f"{node}: knn input {port} value_shape "
+                            f"{s.value_shape} != (dim={op.dim},)")
+                D = in_specs[1].key_space
+                if D > op.scan_chunk and D % op.scan_chunk:
+                    raise GraphError(
+                        f"{node}: corpus key_space {D} must be a multiple "
+                        f"of scan_chunk {op.scan_chunk}")
+                from reflow_tpu.executors.lowerings import knn_state
+                self.states[node.id] = knn_state(op, *in_specs)
             elif op.kind == "join":
                 if not in_specs[0].unique:
                     raise GraphError(
@@ -206,6 +219,10 @@ class TpuExecutor(Executor):
             keys = np.nonzero(lw > 0)[0]
             return {int(k): lval[k] if lval.ndim > 1 else lval[k].item()
                     for k in keys}
+        if node.op.kind == "knn":
+            has = np.asarray(st["em_has"])
+            rows = np.asarray(st["emitted"])
+            return {int(q): rows[q] for q in np.nonzero(has)[0]}
         raise KeyError(f"{node} ({node.op.kind}) has no table to read")
 
     def _track_arena(self, plan, ingress_caps: Dict[int, int]):
@@ -241,6 +258,8 @@ class TpuExecutor(Executor):
             elif node.op.kind == "reduce":
                 K = node.inputs[0].spec.key_space
                 outs_cap[node.id] = 2 * K if caps[0] >= K else 2 * caps[0]
+            elif node.op.kind == "knn":
+                outs_cap[node.id] = 2 * node.inputs[0].spec.key_space
             elif node.op.kind == "union":
                 outs_cap[node.id] = sum(caps)
             else:

@@ -17,7 +17,8 @@ import dataclasses
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from reflow_tpu.delta import DeltaBatch, Spec
-from reflow_tpu.ops import Filter, GroupBy, Join, Map, Op, Reduce, Union
+from reflow_tpu.ops import (Filter, GroupBy, Join, KnnIndex, Map, Op, Reduce,
+                            Union)
 
 __all__ = ["Node", "FlowGraph", "GraphError"]
 
@@ -164,6 +165,11 @@ class FlowGraph:
 
     def union(self, *inputs: Node, name: Optional[str] = None) -> Node:
         return self.add_op(Union(arity=len(inputs)), list(inputs), name=name)
+
+    def knn(self, queries: Node, docs: Node, k: int, dim: int, *,
+            name: Optional[str] = None, scan_chunk: int = 8192) -> Node:
+        op = KnnIndex(k, dim, scan_chunk=scan_chunk)
+        return self.add_op(op, [queries, docs], name=name)
 
     # -- structure queries -------------------------------------------------
 

@@ -18,5 +18,7 @@ from reflow_tpu.ops.core import (
     Union,
     REDUCERS,
 )
+from reflow_tpu.ops.knn import KnnIndex
 
-__all__ = ["Op", "Map", "Filter", "GroupBy", "Reduce", "Join", "Union", "REDUCERS"]
+__all__ = ["Op", "Map", "Filter", "GroupBy", "Reduce", "Join", "Union",
+           "KnnIndex", "REDUCERS"]

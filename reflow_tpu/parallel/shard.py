@@ -51,6 +51,10 @@ class ShardedTpuExecutor(TpuExecutor):
         super().bind(graph)
         n = self.n
         for node in graph.nodes:
+            if node.kind == "op" and node.op.kind == "knn":
+                raise GraphError(
+                    f"{node}: knn has no sharded lowering yet; run it on "
+                    f"the single-device TpuExecutor")
             if node.kind != "op" or node.op.kind not in ("reduce", "join"):
                 continue
             K = node.inputs[0].spec.key_space

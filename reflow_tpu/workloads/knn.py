@@ -1,0 +1,87 @@
+"""Benchmark config 4: k-NN re-index on embedding deltas.
+
+BASELINE.md: "k-NN re-index on 1Mx768 embedding deltas (vmapped cosine,
+Pallas top-k)". The graph is two sources (queries, corpus) feeding a
+:class:`~reflow_tpu.ops.KnnIndex` op; the maintained collection is each
+query's top-k corpus ids by cosine similarity, re-indexed incrementally as
+embedding deltas arrive. The host driver streams batches of corpus
+insertions (the re-index flow) and occasional retractions (which trigger
+the chunked full corpus rescan on device).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from reflow_tpu.delta import DeltaBatch, Spec
+from reflow_tpu.graph import FlowGraph, Node
+
+
+@dataclasses.dataclass
+class KnnGraph:
+    graph: FlowGraph
+    queries: Node
+    docs: Node
+    index: Node   # read_table -> {query_id: [k, 2] (doc_id, score) rows}
+
+
+def build_graph(n_queries: int, n_docs: int, dim: int, k: int,
+                *, scan_chunk: int = 8192) -> KnnGraph:
+    g = FlowGraph("knn")
+    q = g.source("queries", Spec((dim,), np.float32, key_space=n_queries))
+    d = g.source("docs", Spec((dim,), np.float32, key_space=n_docs))
+    idx = g.knn(q, d, k, dim, name="index", scan_chunk=scan_chunk)
+    return KnnGraph(g, q, d, idx)
+
+
+# -- host-side data + churn driver ----------------------------------------
+
+@dataclasses.dataclass
+class EmbeddingStore:
+    """Host mirror of the corpus for generating deltas + the oracle."""
+
+    dim: int
+    rng: np.random.Generator
+    vecs: dict  # id -> raw (unnormalized) vector
+
+    @staticmethod
+    def create(dim: int, seed: int = 0) -> "EmbeddingStore":
+        return EmbeddingStore(dim, np.random.default_rng(seed), {})
+
+    def _random(self, n: int) -> np.ndarray:
+        return self.rng.normal(size=(n, self.dim)).astype(np.float32)
+
+    def insert_batch(self, ids: np.ndarray) -> DeltaBatch:
+        vals = self._random(len(ids))
+        for i, v in zip(ids, vals):
+            self.vecs[int(i)] = v
+        return DeltaBatch(np.asarray(ids, np.int64), vals,
+                          np.ones(len(ids), np.int64))
+
+    def retract_batch(self, ids: np.ndarray) -> DeltaBatch:
+        vals = np.stack([self.vecs.pop(int(i)) for i in ids])
+        return DeltaBatch(np.asarray(ids, np.int64), vals,
+                          -np.ones(len(ids), np.int64))
+
+    def reference_topk(self, queries: np.ndarray, k: int
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+        """Brute-force float64 oracle -> (ids [Q,k], scores [Q,k])."""
+        ids = np.array(sorted(self.vecs), np.int64)
+        if not len(ids):
+            return (np.full((len(queries), k), -1, np.int64),
+                    np.full((len(queries), k), -np.inf))
+        mat = np.stack([self.vecs[int(i)] for i in ids]).astype(np.float64)
+        mat /= np.maximum(np.linalg.norm(mat, axis=1, keepdims=True), 1e-30)
+        qn = queries.astype(np.float64)
+        qn /= np.maximum(np.linalg.norm(qn, axis=1, keepdims=True), 1e-30)
+        s = qn @ mat.T
+        take = np.argsort(-s, axis=1, kind="stable")[:, :k]
+        out_ids = np.full((len(queries), k), -1, np.int64)
+        out_s = np.full((len(queries), k), -np.inf)
+        m = min(k, len(ids))
+        out_ids[:, :m] = ids[take[:, :m]]
+        out_s[:, :m] = np.take_along_axis(s, take, 1)[:, :m]
+        return out_ids, out_s

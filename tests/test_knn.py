@@ -1,0 +1,97 @@
+"""k-NN re-index (config 4): kernel parity, CPU-vs-TPU differential, and
+the incremental insert path vs the full-rescan path."""
+
+import numpy as np
+import pytest
+
+from reflow_tpu import DeltaBatch, DirtyScheduler
+from reflow_tpu.executors import CpuExecutor, get_executor
+from reflow_tpu.workloads import knn
+
+Q, D, DIM, K = 16, 256, 32, 4
+
+
+def _drive(executor, seed=0, retract=True):
+    kg = knn.build_graph(Q, D, DIM, K, scan_chunk=D)
+    sched = DirtyScheduler(kg.graph, executor)
+    store = knn.EmbeddingStore.create(DIM, seed=seed)
+    rng = np.random.default_rng(seed + 100)
+    qvecs = rng.normal(size=(Q, DIM)).astype(np.float32)
+    sched.push(kg.queries, DeltaBatch(np.arange(Q), qvecs))
+    sched.push(kg.docs, store.insert_batch(np.arange(0, 64)))
+    sched.tick()
+    # pure insert tick (incremental path on device)
+    sched.push(kg.docs, store.insert_batch(np.arange(64, 128)))
+    sched.tick()
+    if retract:
+        # retraction tick (full rescan path on device)
+        sched.push(kg.docs, store.retract_batch(np.arange(10, 30)))
+        sched.tick()
+        sched.push(kg.docs, store.insert_batch(np.arange(128, 160)))
+        sched.tick()
+    return sched, kg, store, qvecs
+
+
+def _ids_table(sched, kg):
+    return {q: row[:, 0].astype(np.int64)
+            for q, row in sched.read_table(kg.index).items()}
+
+
+def test_cpu_matches_bruteforce_oracle():
+    sched, kg, store, qvecs = _drive(CpuExecutor())
+    ref_ids, _ = store.reference_topk(qvecs, K)
+    table = _ids_table(sched, kg)
+    for q in range(Q):
+        np.testing.assert_array_equal(table[q], ref_ids[q])
+
+
+def test_tpu_matches_bruteforce_oracle():
+    sched, kg, store, qvecs = _drive(get_executor("tpu"))
+    ref_ids, ref_s = store.reference_topk(qvecs, K)
+    table = _ids_table(sched, kg)
+    for q in range(Q):
+        np.testing.assert_array_equal(table[q], ref_ids[q])
+
+
+def test_cpu_tpu_views_match():
+    s_cpu, kg_cpu, _, _ = _drive(CpuExecutor(), seed=3)
+    s_tpu, kg_tpu, _, _ = _drive(get_executor("tpu"), seed=3)
+    t_cpu = s_cpu.read_table(kg_cpu.index)
+    t_tpu = s_tpu.read_table(kg_tpu.index)
+    assert set(t_cpu) == set(t_tpu)
+    for q in t_cpu:
+        np.testing.assert_array_equal(
+            t_cpu[q][:, 0].astype(np.int64),
+            t_tpu[q][:, 0].astype(np.int64))
+        np.testing.assert_allclose(t_cpu[q][:, 1], t_tpu[q][:, 1],
+                                   atol=1e-5)
+
+
+def test_incremental_vs_full_oracle_property():
+    """Rebuilding from scratch on the accumulated corpus equals the
+    incrementally maintained index (SURVEY.md §4b, for knn)."""
+    sched, kg, store, qvecs = _drive(get_executor("tpu"), seed=7)
+    # fresh graph fed the *current* corpus in one shot
+    kg2 = knn.build_graph(Q, D, DIM, K, scan_chunk=D)
+    sched2 = DirtyScheduler(kg2.graph, get_executor("tpu"))
+    sched2.push(kg2.queries, DeltaBatch(np.arange(Q),
+                                        qvecs))
+    ids = np.array(sorted(store.vecs), np.int64)
+    vals = np.stack([store.vecs[int(i)] for i in ids])
+    sched2.push(kg2.docs, DeltaBatch(ids, vals))
+    sched2.tick()
+    a, b = _ids_table(sched, kg), _ids_table(sched2, kg2)
+    assert set(a) == set(b)
+    for q in a:
+        np.testing.assert_array_equal(a[q], b[q])
+
+
+def test_query_retraction_removes_row():
+    ex = get_executor("tpu")
+    sched, kg, store, qvecs = _drive(ex, retract=False)
+    sink_view_before = len(sched.read_table(kg.index))
+    assert sink_view_before == Q
+    sched.push(kg.queries, DeltaBatch(np.arange(3), qvecs[:3],
+                                      -np.ones(3, np.int64)))
+    sched.tick()
+    assert len(sched.read_table(kg.index)) == Q - 3
