@@ -1,0 +1,6 @@
+"""Model zoo for model-in-the-loop workloads (SURVEY.md §2 item 12,
+config 5: ViT feature extraction embedded as a Map function)."""
+
+from reflow_tpu.models.vit import VIT_B_16, VIT_TINY, init_vit, vit_forward
+
+__all__ = ["init_vit", "vit_forward", "VIT_B_16", "VIT_TINY"]

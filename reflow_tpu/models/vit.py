@@ -1,0 +1,114 @@
+"""Vision Transformer feature extractor, pure JAX (config 5's Map model).
+
+A deliberately flat implementation: params are a plain pytree, the forward
+is a jit-able pure function, so it embeds directly as a vectorized Map
+function in a FlowGraph and shards data-parallel under ``shard_map`` (the
+per-shard batch just flows through the same pure function). bfloat16
+matmul inputs with float32 accumulation — the MXU-native regime.
+
+Structure (standard pre-LN ViT): patchify -> linear proj + learned pos
+embedding -> depth x [LN, MSA, residual, LN, MLP(gelu), residual] -> final
+LN -> mean pool over patches. Feature dim = ``dim``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["init_vit", "vit_forward", "VIT_B_16", "VIT_TINY"]
+
+#: ViT-B/16 (the reference workload's extractor)
+VIT_B_16 = dict(img=224, chans=3, patch=16, dim=768, depth=12, heads=12,
+                mlp_dim=3072)
+#: tiny config for CI (CPU-mesh differential tests)
+VIT_TINY = dict(img=16, chans=3, patch=8, dim=32, depth=2, heads=4,
+                mlp_dim=64)
+
+
+def init_vit(seed: int, *, img: int, chans: int, patch: int, dim: int,
+             depth: int, heads: int, mlp_dim: int,
+             dtype=jnp.float32) -> Dict:
+    rng = np.random.default_rng(seed)
+    n_patches = (img // patch) ** 2
+    pdim = patch * patch * chans
+
+    def dense(*shape):
+        w = rng.normal(0, shape[0] ** -0.5, shape).astype(np.float32)
+        return jnp.asarray(w, dtype)
+
+    params = {
+        "proj_w": dense(pdim, dim),
+        "proj_b": jnp.zeros((dim,), dtype),
+        "pos": jnp.asarray(
+            rng.normal(0, 0.02, (n_patches, dim)).astype(np.float32), dtype),
+        "ln_f": {"g": jnp.ones((dim,), dtype), "b": jnp.zeros((dim,), dtype)},
+        "blocks": [],
+    }
+    for _ in range(depth):
+        params["blocks"].append({
+            "ln1": {"g": jnp.ones((dim,), dtype),
+                    "b": jnp.zeros((dim,), dtype)},
+            "ln2": {"g": jnp.ones((dim,), dtype),
+                    "b": jnp.zeros((dim,), dtype)},
+            "wq": dense(dim, dim), "wk": dense(dim, dim),
+            "wv": dense(dim, dim), "wo": dense(dim, dim),
+            "w1": dense(dim, mlp_dim),
+            "b1": jnp.zeros((mlp_dim,), dtype),
+            "w2": dense(mlp_dim, dim),
+            "b2": jnp.zeros((dim,), dtype),
+        })
+    params["_cfg"] = dict(img=img, chans=chans, patch=patch, dim=dim,
+                          depth=depth, heads=heads, mlp_dim=mlp_dim)
+    return params
+
+
+def _ln(x, p):
+    m = jnp.mean(x, -1, keepdims=True)
+    v = jnp.var(x, -1, keepdims=True)
+    return (x - m) * jax.lax.rsqrt(v + 1e-6) * p["g"] + p["b"]
+
+
+def _dot(a, b):
+    # bf16 inputs, f32 accumulation: the MXU-native matmul regime
+    return jnp.dot(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                   preferred_element_type=jnp.float32)
+
+
+def _attn(x, blk, heads):
+    n, d = x.shape[-2], x.shape[-1]
+    hd = d // heads
+
+    def split(w):
+        y = _dot(x, w)
+        return y.reshape(*y.shape[:-1], heads, hd)
+
+    q, k, v = split(blk["wq"]), split(blk["wk"]), split(blk["wv"])
+    logits = jnp.einsum("...qhd,...khd->...hqk", q, k,
+                        preferred_element_type=jnp.float32) * (hd ** -0.5)
+    a = jax.nn.softmax(logits, axis=-1)
+    o = jnp.einsum("...hqk,...khd->...qhd", a, v,
+                   preferred_element_type=jnp.float32)
+    return _dot(o.reshape(*o.shape[:-2], d), blk["wo"])
+
+
+def vit_forward(params: Dict, images: jax.Array) -> jax.Array:
+    """images [B, H, W, C] (or [B, H*W*C] flat) -> features [B, dim]."""
+    cfg = params["_cfg"]
+    img, chans, patch = cfg["img"], cfg["chans"], cfg["patch"]
+    b = images.shape[0]
+    x = images.reshape(b, img, img, chans).astype(jnp.float32)
+    g = img // patch
+    # patchify: [B, g, p, g, p, C] -> [B, g*g, p*p*C]
+    x = x.reshape(b, g, patch, g, patch, chans)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, g * g, patch * patch * chans)
+    x = _dot(x, params["proj_w"]) + params["proj_b"] + params["pos"]
+    for blk in params["blocks"]:
+        x = x + _attn(_ln(x, blk["ln1"]), blk, cfg["heads"])
+        h = _dot(_ln(x, blk["ln2"]), blk["w1"]) + blk["b1"]
+        x = x + _dot(jax.nn.gelu(h), blk["w2"]) + blk["b2"]
+    x = _ln(x, params["ln_f"])
+    return jnp.mean(x, axis=-2)

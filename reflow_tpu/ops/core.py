@@ -141,15 +141,22 @@ class GroupBy(Op):
 
 # -- Reduce ---------------------------------------------------------------
 
-def _agg_sum(ms: Counter) -> float:
-    return sum(v * w for v, w in ms.items())
+def _wv(v, w):
+    """Weighted value; vector values (stored as tuples) go through numpy."""
+    if isinstance(v, tuple):
+        return np.asarray(v, np.float64) * w
+    return v * w
+
+
+def _agg_sum(ms: Counter):
+    return sum(_wv(v, w) for v, w in ms.items())
 
 
 def _agg_count(ms: Counter) -> int:
     return sum(ms.values())
 
 
-def _agg_mean(ms: Counter) -> float:
+def _agg_mean(ms: Counter):
     n = sum(ms.values())
     return _agg_sum(ms) / n
 
@@ -239,11 +246,16 @@ class Reduce(Op):
         elif self.how in ("mean", "count"):
             if sum(ms.values()) == 0:
                 return _NO_AGG
-        elif self.how == "sum":
-            if sum(ms.values()) == 0 and _agg_sum(ms) == 0:
-                return _NO_AGG
         fn, _ = REDUCERS[self.how]
-        return fn(ms)
+        agg = fn(ms)
+        if self.how == "sum":
+            if (sum(ms.values()) == 0 and
+                    bool(np.all(np.asarray(agg) == 0))):
+                return _NO_AGG
+        if isinstance(agg, np.ndarray):
+            # vector aggregate: keep it hashable for the emission multiset
+            agg = tuple(agg.tolist())
+        return agg
 
     def apply(self, state, in_batches):
         (b,) = in_batches
@@ -276,6 +288,16 @@ class Reduce(Op):
 
 
 def _close(a, b, tol: float) -> bool:
+    if isinstance(a, tuple) or isinstance(b, tuple):
+        if tol <= 0.0:
+            return a == b
+        try:
+            av = np.asarray(a, np.float64)
+            bv = np.asarray(b, np.float64)
+            ok = (np.abs(av - bv) <= tol) | (np.isnan(av) & np.isnan(bv))
+            return bool(np.all(ok))
+        except (TypeError, ValueError):
+            return a == b
     if tol <= 0.0:
         return a == b
     try:

@@ -1,0 +1,70 @@
+"""Streaming TF-IDF (config 2): edit-delta ingestion, incremental tables
+vs brute-force oracle, on all three executors."""
+
+from reflow_tpu import DirtyScheduler
+from reflow_tpu.executors import CpuExecutor, get_executor
+from reflow_tpu.parallel import make_mesh
+from reflow_tpu.parallel.shard import ShardedTpuExecutor
+from reflow_tpu.workloads import tfidf
+
+DOCS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the cat sat on the mat",
+    "a quick brown cat",
+    "dogs and cats living together",
+    "the dog chased the cat over the mat",
+]
+
+
+def _drive(executor):
+    tg = tfidf.build_graph(n_pairs=256, n_terms=64, n_docs=16)
+    sched = DirtyScheduler(tg.graph, executor)
+    corpus = tfidf.Corpus(256, 64)
+    # initial corpus, one doc per tick (streaming)
+    for i, text in enumerate(DOCS[:3]):
+        sched.push(tg.tokens, corpus.edit(i, text))
+        sched.tick()
+    # batch tick with two more docs
+    from reflow_tpu.delta import DeltaBatch
+
+    sched.push(tg.tokens, DeltaBatch.concat(
+        [corpus.edit(3, DOCS[3]), corpus.edit(4, DOCS[4])]))
+    sched.tick()
+    # edit an existing doc (retract+insert deltas), delete another
+    sched.push(tg.tokens, corpus.edit(1, "the cat sat on a new hat"))
+    sched.tick()
+    sched.push(tg.tokens, corpus.edit(2, None))
+    sched.tick()
+    return sched, tg, corpus
+
+
+def _check(sched, tg, corpus):
+    got = tfidf.tfidf_view(sched, tg, corpus)
+    ref = corpus.reference_tfidf()
+    assert set(got) == set(ref)
+    for k in ref:
+        assert abs(got[k] - ref[k]) < 1e-5, (k, got[k], ref[k])
+    # N table
+    (n,) = sched.read_table(tg.ndocs).values()
+    assert int(n) == len(corpus.docs)
+
+
+def test_cpu_matches_oracle():
+    _check(*_drive(CpuExecutor()))
+
+
+def test_tpu_matches_oracle():
+    _check(*_drive(get_executor("tpu")))
+
+
+def test_sharded_matches_oracle():
+    _check(*_drive(ShardedTpuExecutor(make_mesh(8))))
+
+
+def test_cpu_tpu_tables_identical():
+    s1, tg1, _ = _drive(CpuExecutor())
+    s2, tg2, _ = _drive(get_executor("tpu"))
+    for node1, node2 in ((tg1.tf, tg2.tf), (tg1.df, tg2.df)):
+        t1 = {int(k): float(v) for k, v in s1.read_table(node1).items()}
+        t2 = {int(k): float(v) for k, v in s2.read_table(node2).items()}
+        assert t1 == t2
