@@ -60,6 +60,11 @@ class Executor(abc.ABC):
         """Convert a (possibly device-resident) sink egress batch to host."""
         return batch
 
+    def check_errors(self) -> None:
+        """Raise if any op state carries a sticky error flag (called by the
+        scheduler once per tick, so invalid state fails loudly instead of
+        leaking corrupt deltas into sink views)."""
+
     def read_table(self, node: Node) -> Dict:
         """Materialized {key: value} of a stateful node's collection.
 

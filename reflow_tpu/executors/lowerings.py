@@ -23,8 +23,11 @@ Keyed-state representations:
   its retract/insert halves scattered to dense temp tables so the arena-side
   product is a pure gather (this is the SpMV shape the MXU/VPU wants).
 
-Non-linear reducers (min/max) stay on the CPU oracle path for now; a
-recompute-on-retract device lowering is planned (SURVEY.md §7 hard part c).
+Non-linear reducers (min/max) lower to insert-only scatter-extrema on
+device (see ``_lower_reduce_minmax``): a retraction cannot be undone
+without the full per-key multiset, so it sets a sticky error flag the
+scheduler surfaces after the tick — retraction-bearing min/max belongs on
+the CPU oracle (SURVEY.md §7 hard part c).
 """
 
 from __future__ import annotations
@@ -42,7 +45,12 @@ from reflow_tpu.ops import Filter, GroupBy, Join, Map, Reduce, Union
 __all__ = ["lower_node", "reduce_state", "join_state", "join_core",
            "knn_state", "DEVICE_REDUCERS"]
 
-DEVICE_REDUCERS = ("sum", "count", "mean")
+#: sum/count/mean lower to linear scatter-adds; min/max lower to scatter
+#: extrema and are INSERT-ONLY on device (a retraction can't be undone
+#: without the full multiset — it sets a sticky per-node error flag that
+#: read_table surfaces; run retraction-heavy min/max on the CPU oracle)
+DEVICE_REDUCERS = ("sum", "count", "mean", "min", "max")
+LINEAR_DEVICE_REDUCERS = ("sum", "count", "mean")
 
 
 # -- state builders --------------------------------------------------------
@@ -51,6 +59,15 @@ def reduce_state(op: Reduce, in_spec: Spec, out_spec: Spec) -> dict:
     K = in_spec.key_space
     vshape = tuple(in_spec.value_shape)
     oshape = tuple(out_spec.value_shape)
+    if op.how not in LINEAR_DEVICE_REDUCERS:
+        init = jnp.inf if op.how == "min" else -jnp.inf
+        return {
+            "agg": jnp.full((K,) + vshape, init, jnp.float32),
+            "wcnt": jnp.zeros((K,), jnp.int32),
+            "emitted": jnp.zeros((K,) + oshape, out_spec.value_dtype),
+            "emitted_has": jnp.zeros((K,), jnp.bool_),
+            "error": jnp.zeros((), jnp.bool_),
+        }
     return {
         "wsum": jnp.zeros((K,) + vshape, jnp.float32),
         "wcnt": jnp.zeros((K,), jnp.int32),
@@ -172,7 +189,46 @@ def _agg_tables(op: Reduce, wsum, wcnt, vdtype):
     return agg, exists
 
 
+def _lower_reduce_minmax(op: Reduce, node: Node, state, ins
+                         ) -> Tuple[DeviceDelta, dict]:
+    """Insert-only scatter-extrema path; retractions set the error flag."""
+    (d,) = ins
+    K = node.inputs[0].spec.key_space
+    vdtype = node.spec.value_dtype
+    pad = jnp.inf if op.how == "min" else -jnp.inf
+
+    live_keys = jnp.where(d.weights > 0, d.keys, K)
+    vals = jnp.where(_bcast_w(d.weights > 0, d.values),
+                     d.values.astype(jnp.float32), pad)
+    if op.how == "min":
+        agg = state["agg"].at[live_keys].min(vals, mode="drop")
+    else:
+        agg = state["agg"].at[live_keys].max(vals, mode="drop")
+    wcnt = state["wcnt"].at[d.keys].add(d.weights)
+    error = state["error"] | jnp.any(d.weights < 0)
+
+    emitted, em_has = state["emitted"], state["emitted_has"]
+    exists = wcnt > 0
+    aggv = jnp.asarray(agg, vdtype)
+    changed = _differs(aggv, emitted, op.tol)
+    ins_m = exists & (~em_has | changed)
+    ret_m = em_has & (~exists | changed)
+    all_keys = jnp.arange(K, dtype=jnp.int32)
+    out = DeviceDelta(
+        keys=jnp.concatenate([all_keys, all_keys]),
+        values=jnp.concatenate([emitted, aggv]),
+        weights=jnp.concatenate(
+            [-ret_m.astype(jnp.int32), ins_m.astype(jnp.int32)]),
+    )
+    new_emitted = jnp.where(_bcast_w(ins_m, aggv), aggv, emitted)
+    new_has = jnp.where(ins_m, True, jnp.where(ret_m & ~exists, False, em_has))
+    return out, {"agg": agg, "wcnt": wcnt, "emitted": new_emitted,
+                 "emitted_has": new_has, "error": error}
+
+
 def _lower_reduce(op: Reduce, node: Node, state, ins) -> Tuple[DeviceDelta, dict]:
+    if op.how not in LINEAR_DEVICE_REDUCERS:
+        return _lower_reduce_minmax(op, node, state, ins)
     (d,) = ins
     in_spec = node.inputs[0].spec
     K = in_spec.key_space

@@ -201,6 +201,16 @@ class TpuExecutor(Executor):
             return to_host(batch)
         return batch
 
+    def check_errors(self) -> None:
+        for nid, st in self.states.items():
+            if isinstance(st, dict) and "error" in st and bool(st["error"]):
+                node = self.graph.nodes[nid]
+                raise RuntimeError(
+                    f"{node}: a retraction reached a device min/max "
+                    f"reducer (insert-only on device); this tick's state "
+                    f"is invalid — run retraction-bearing min/max on the "
+                    f"CPU executor")
+
     def read_table(self, node: Node):
         import numpy as np
 
@@ -208,6 +218,11 @@ class TpuExecutor(Executor):
         if st is None:
             raise KeyError(f"{node} holds no materialized state")
         if node.op.kind == "reduce":
+            if "error" in st and bool(st["error"]):
+                raise RuntimeError(
+                    f"{node}: a retraction reached a device min/max "
+                    f"reducer (insert-only on device) — this table is "
+                    f"invalid; rerun on the CPU executor")
             has = np.asarray(st["emitted_has"])
             vals = np.asarray(st["emitted"])
             keys = np.nonzero(has)[0]

@@ -62,6 +62,15 @@ class ShardedTpuExecutor(TpuExecutor):
                 raise GraphError(
                     f"{node}: key_space {K} must be a multiple of the mesh "
                     f"size {n} (round it up)")
+            if node.op.kind == "reduce":
+                from reflow_tpu.executors.lowerings import \
+                    LINEAR_DEVICE_REDUCERS
+
+                if node.op.how not in LINEAR_DEVICE_REDUCERS:
+                    raise GraphError(
+                        f"{node}: {node.op.how} has no sharded lowering "
+                        f"yet; use the single-device TpuExecutor or the "
+                        f"CPU oracle")
             if node.op.kind == "join":
                 if node.op.arena_capacity % n:
                     raise GraphError(

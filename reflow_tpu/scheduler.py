@@ -50,26 +50,46 @@ class TickResult:
 
 class DirtyScheduler:
     def __init__(self, graph: FlowGraph, executor: Optional[Executor] = None,
-                 *, max_loop_iters: int = 10_000):
+                 *, max_loop_iters: int = 10_000,
+                 dedup_window: int = 1 << 20):
         graph.validate()
         self.graph = graph
         self.executor = executor if executor is not None else CpuExecutor()
         self.executor.bind(graph)
         self.max_loop_iters = max_loop_iters
         self._pending: Dict[int, List[DeltaBatch]] = defaultdict(list)
+        #: insertion-ordered dedup set for idempotent pushes, bounded to
+        #: the newest ``dedup_window`` ids (upstream redelivery must stay
+        #: within that horizon)
+        self._seen_batch_ids: Dict[str, None] = {}
+        self.dedup_window = dedup_window
         self._tick = 0
         self.sink_views: Dict[str, Counter] = {s.name: Counter() for s in graph.sinks}
         self.history: List[TickResult] = []
 
     # -- host boundary in --------------------------------------------------
 
-    def push(self, source: Node, batch: DeltaBatch) -> None:
+    def push(self, source: Node, batch: DeltaBatch, *,
+             batch_id: Optional[str] = None) -> bool:
         """Buffer deltas at a source — or at a loop variable, which is how a
-        fixpoint computation receives its initial condition."""
+        fixpoint computation receives its initial condition.
+
+        ``batch_id`` makes ingestion idempotent (exactly-once under
+        at-least-once upstream delivery, SURVEY.md §5): a batch whose id
+        was already accepted — including before a checkpoint/restore — is
+        dropped. Returns whether the batch was accepted.
+        """
         if source.kind not in ("source", "loop"):
             raise GraphError(f"can only push to sources/loops, not {source}")
+        if batch_id is not None:
+            if batch_id in self._seen_batch_ids:
+                return False
+            self._seen_batch_ids[batch_id] = None
+            while len(self._seen_batch_ids) > self.dedup_window:
+                self._seen_batch_ids.pop(next(iter(self._seen_batch_ids)))
         if len(batch):
             self._pending[source.id].append(batch)
+        return True
 
     # -- dirty planning (structural) --------------------------------------
 
@@ -134,6 +154,11 @@ class DirtyScheduler:
                 elif len(batch):  # loop back-edge -> next pass
                     ingress[nid] = batch
                     deltas_in += len(batch)
+
+        # fail loudly if any op state carries a sticky error flag (e.g. a
+        # retraction reached an insert-only device min/max) BEFORE corrupt
+        # deltas are folded into the materialized sink views
+        self.executor.check_errors()
 
         out: Dict[str, DeltaBatch] = {}
         for name, batches in sink_deltas.items():

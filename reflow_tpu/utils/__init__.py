@@ -1,0 +1,8 @@
+"""Auxiliary subsystems (SURVEY.md §5): checkpoint/resume, metrics,
+fault-tolerant ingestion."""
+
+from reflow_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+from reflow_tpu.utils.metrics import MetricsSummary, summarize
+
+__all__ = ["save_checkpoint", "load_checkpoint", "summarize",
+           "MetricsSummary"]

@@ -1,0 +1,98 @@
+"""Durable checkpoint/resume (SURVEY.md §5).
+
+The durable state of an incremental dataflow is small and well-defined:
+(per-node operator state, tick counter, materialized sink views). Sources
+are the user's responsibility to replay from their own cursor — the
+checkpoint records ``tick`` so the host driver knows where its cursor was.
+
+Two serialization paths behind one API:
+
+- **array states** (TpuExecutor / ShardedTpuExecutor): the state pytree is
+  saved via ``orbax.checkpoint`` — zarr-sharded, async-capable, and on
+  restore each leaf is loaded *directly into the executor's current
+  sharding* (the live state tree provides the abstract target), so a
+  key-sharded table comes back key-sharded without a host gather.
+- **host states** (CpuExecutor's dict/Counter oracle state): pickle.
+
+Layout: ``<dir>/meta.pkl`` (tick, sink views, host states) and
+``<dir>/states/`` (orbax tree of the array states, if any).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Dict
+
+__all__ = ["save_checkpoint", "load_checkpoint"]
+
+
+def _split_states(states: Dict[int, object]):
+    """Partition per-node states into (array pytrees, host objects)."""
+    import jax
+
+    arr, host = {}, {}
+    for nid, st in states.items():
+        if isinstance(st, dict) and st and all(
+                isinstance(v, jax.Array) for v in st.values()):
+            arr[str(nid)] = st
+        else:
+            host[nid] = st
+    return arr, host
+
+
+def save_checkpoint(sched, path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    arr, host = _split_states(sched.executor.states)
+    meta = {
+        "tick": sched._tick,
+        "sink_views": {name: dict(c) for name, c in sched.sink_views.items()},
+        "seen_batch_ids": dict(sched._seen_batch_ids),
+        # accepted-but-unticked batches: without these, a crash between
+        # push and tick would lose deltas whose ids the dedup set already
+        # claims (exactly-once would silently become at-most-once)
+        "pending": {nid: list(batches)
+                    for nid, batches in sched._pending.items()},
+        "host_states": pickle.dumps(host),
+        "has_array_states": bool(arr),
+    }
+    with open(os.path.join(path, "meta.pkl"), "wb") as f:
+        pickle.dump(meta, f)
+    if arr:
+        import orbax.checkpoint as ocp
+
+        ckpt = ocp.StandardCheckpointer()
+        ckpt.save(os.path.join(os.path.abspath(path), "states"), arr,
+                  force=True)
+        ckpt.wait_until_finished()
+
+
+def load_checkpoint(sched, path: str) -> None:
+    """Restore into a scheduler whose graph/executor match the saved one."""
+    from collections import Counter
+
+    with open(os.path.join(path, "meta.pkl"), "rb") as f:
+        meta = pickle.load(f)
+    sched._tick = meta["tick"]
+    sched._seen_batch_ids = dict(meta["seen_batch_ids"])
+    sched._pending.clear()
+    for nid, batches in meta["pending"].items():
+        sched._pending[nid].extend(batches)
+    for name, d in meta["sink_views"].items():
+        sched.sink_views[name] = Counter(d)
+    states = dict(pickle.loads(meta["host_states"]))
+    if meta["has_array_states"]:
+        import orbax.checkpoint as ocp
+
+        live_arr, _ = _split_states(sched.executor.states)
+        if not live_arr:
+            raise ValueError(
+                "checkpoint holds array states but the bound executor has "
+                "none — restore onto the same executor kind it was saved "
+                "from")
+        ckpt = ocp.StandardCheckpointer()
+        restored = ckpt.restore(
+            os.path.join(os.path.abspath(path), "states"), live_arr)
+        for sid, st in restored.items():
+            states[int(sid)] = st
+    sched.executor.states = states

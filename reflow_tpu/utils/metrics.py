@@ -1,0 +1,69 @@
+"""Metrics/observability (SURVEY.md §5): aggregate the scheduler's
+per-tick records into the BASELINE metrics, and profile a tick on device.
+
+``TickResult`` (scheduler.py) is the raw per-tick record: deltas in/out,
+dirty-set size, pass count, wall time. This module turns a run's history
+into the headline numbers (delta-ops/sec, percentile tick walls) and
+offers a ``jax.profiler`` context for capturing a device trace of a tick.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import List, Sequence
+
+import numpy as np
+
+__all__ = ["MetricsSummary", "summarize", "profile_trace"]
+
+
+@dataclasses.dataclass
+class MetricsSummary:
+    ticks: int
+    delta_ops: int
+    wall_s: float
+    delta_ops_per_s: float
+    tick_p50_s: float
+    tick_p95_s: float
+    passes_mean: float
+    quiesced_all: bool
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def summarize(history: Sequence) -> MetricsSummary:
+    """Aggregate a scheduler's ``history`` (list of TickResult)."""
+    if not history:
+        return MetricsSummary(0, 0, 0.0, 0.0, 0.0, 0.0, 0.0, True)
+    walls = np.array([r.wall_s for r in history])
+    dops = sum(r.delta_ops for r in history)
+    return MetricsSummary(
+        ticks=len(history),
+        delta_ops=int(dops),
+        wall_s=float(walls.sum()),
+        delta_ops_per_s=float(dops / max(walls.sum(), 1e-12)),
+        tick_p50_s=float(np.percentile(walls, 50)),
+        tick_p95_s=float(np.percentile(walls, 95)),
+        passes_mean=float(np.mean([r.passes for r in history])),
+        quiesced_all=all(r.quiesced for r in history),
+    )
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """Capture a ``jax.profiler`` device trace around a block of ticks::
+
+        with profile_trace("/tmp/trace"):
+            sched.tick()
+
+    View with TensorBoard / xprof against the produced log dir.
+    """
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

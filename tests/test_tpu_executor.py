@@ -157,12 +157,17 @@ def test_tpu_rejects_unkeyed_spec():
         DirtyScheduler(g, get_executor("tpu"))
 
 
-def test_tpu_rejects_minmax_reducer():
+def test_tpu_accepts_minmax_reducer_insert_only():
+    # min/max now lower to device scatter-extrema (insert-only; see
+    # tests/test_aux.py for the retraction error-flag behavior)
     g = FlowGraph()
     src = g.source("in", Spec((), np.float32, key_space=8))
     g.sink(g.reduce(src, "min"), "out")
-    with pytest.raises(GraphError, match="no device lowering"):
-        DirtyScheduler(g, get_executor("tpu"))
+    sched = DirtyScheduler(g, get_executor("tpu"))
+    sched.push(src, DeltaBatch(np.array([1, 1, 2]),
+                               np.array([3.0, 1.0, 2.0], np.float32)))
+    sched.tick()
+    assert sched.view_dict("out") == {1: 1.0, 2: 2.0}
 
 
 def test_tpu_join_requires_unique_left():
