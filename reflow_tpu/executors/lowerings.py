@@ -226,6 +226,26 @@ def _lower_reduce_minmax(op: Reduce, node: Node, state, ins
                  "emitted_has": new_has, "error": error}
 
 
+def _scatter_contribs(d: DeviceDelta, K: int):
+    """One fused scatter-add of (w*v, w) into a [K, F+1] table.
+
+    TPU scatter cost scales with update rows, so stacking the weighted
+    values and the weights into one update halves the dominant cost of
+    large reduce passes vs two separate scatter-adds.
+    """
+    C = d.capacity
+    vflat = _masked_contrib(d.weights, d.values).astype(
+        jnp.float32).reshape(C, -1)
+    upd = jnp.concatenate(
+        [vflat, d.weights.astype(jnp.float32)[:, None]], axis=-1)
+    table = jnp.zeros((K, upd.shape[1]), jnp.float32).at[d.keys].add(upd)
+    vshape = d.values.shape[1:]
+    dws = table[:, :-1].reshape((K,) + vshape)
+    # weights are ints; their float32 sum is exact below 2**24 rows/key
+    dwc = table[:, -1].astype(jnp.int32)
+    return dws, dwc
+
+
 def _lower_reduce(op: Reduce, node: Node, state, ins) -> Tuple[DeviceDelta, dict]:
     if op.how not in LINEAR_DEVICE_REDUCERS:
         return _lower_reduce_minmax(op, node, state, ins)
@@ -235,8 +255,9 @@ def _lower_reduce(op: Reduce, node: Node, state, ins) -> Tuple[DeviceDelta, dict
     C = d.capacity
     vdtype = node.spec.value_dtype
 
-    wsum = state["wsum"].at[d.keys].add(_masked_contrib(d.weights, d.values))
-    wcnt = state["wcnt"].at[d.keys].add(d.weights)
+    dws, dwc = _scatter_contribs(d, K)
+    wsum = state["wsum"] + dws
+    wcnt = state["wcnt"] + dwc
     emitted, em_has = state["emitted"], state["emitted_has"]
 
     if C >= K:

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from reflow_tpu.executors.device_delta import DeviceDelta
 from reflow_tpu.executors.lowerings import (_LOWERINGS, _agg_tables,
                                             _bcast_w, _differs,
-                                            _masked_contrib, join_core)
+                                            _scatter_contribs, join_core)
 from reflow_tpu.graph import Node
 
 __all__ = ["lower_node_sharded"]
@@ -61,15 +61,16 @@ def _lower_reduce_sharded(op, node: Node, state, ins, axis: str, n: int
     vdtype = node.spec.value_dtype
     base = (jax.lax.axis_index(axis) * Kl).astype(jnp.int32)
 
-    # local full-K contributions, then reduce-scatter to the owned range
+    # local full-K contributions (one fused scatter), then one
+    # reduce-scatter hands each shard its owned range's combined sums
+    dws, dwc = _scatter_contribs(d, K)
     vshape = d.values.shape[1:]
-    contrib = jnp.zeros((K,) + vshape, jnp.float32).at[d.keys].add(
-        _masked_contrib(d.weights, d.values).astype(jnp.float32))
-    cnt = jnp.zeros((K,), jnp.int32).at[d.keys].add(d.weights)
-    wsum = state["wsum"] + jax.lax.psum_scatter(
-        contrib, axis, scatter_dimension=0, tiled=True)
-    wcnt = state["wcnt"] + jax.lax.psum_scatter(
-        cnt, axis, scatter_dimension=0, tiled=True)
+    stacked = jnp.concatenate(
+        [dws.reshape(K, -1), dwc.astype(jnp.float32)[:, None]], axis=-1)
+    combined = jax.lax.psum_scatter(stacked, axis, scatter_dimension=0,
+                                    tiled=True)
+    wsum = state["wsum"] + combined[:, :-1].reshape((Kl,) + vshape)
+    wcnt = state["wcnt"] + combined[:, -1].astype(jnp.int32)
 
     # dense diff over the owned slice (mirrors _lower_reduce dense mode)
     emitted, em_has = state["emitted"], state["emitted_has"]
