@@ -18,3 +18,37 @@ def test_dryrun_multichip_8():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+def test_dryrun_multichip_driver_init_order():
+    """Reproduce the DRIVER's exact invocation: the backend is initialized
+    first with a single device (``jax.devices()``), and only then is
+    ``dryrun_multichip(8)`` called. Round 1 failed precisely here
+    (MULTICHIP_r01.json: rc=1, "need 8 devices, have 1") because the old
+    entry point mutated env in-process after backend init. The fixed entry
+    point must detect the shortfall and re-exec a clean subprocess."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    # a single-device backend, initialized BEFORE dryrun_multichip runs —
+    # exactly what the driver's one-real-chip invocation looks like
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "assert len(jax.devices()) == 1, jax.devices()\n"
+        "import __graft_entry__ as ge\n"
+        "ge.dryrun_multichip(8)\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code], env=env, cwd=repo,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, (
+        f"driver-style dryrun failed rc={proc.returncode}\n"
+        f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
