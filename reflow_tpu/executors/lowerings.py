@@ -152,10 +152,11 @@ def _lower_groupby(op: GroupBy, node: Node, state, ins) -> Tuple[DeviceDelta, No
 
 
 def _lower_union(op: Union, node: Node, state, ins) -> Tuple[DeviceDelta, None]:
+    live = [d for d in ins if d is not None]  # absent streams vanish
     return DeviceDelta(
-        jnp.concatenate([d.keys for d in ins]),
-        jnp.concatenate([d.values for d in ins]),
-        jnp.concatenate([d.weights for d in ins]),
+        jnp.concatenate([d.keys for d in live]),
+        jnp.concatenate([d.values for d in live]),
+        jnp.concatenate([d.weights for d in live]),
     ), None
 
 
@@ -322,57 +323,65 @@ def _lower_join(op: Join, node: Node, state, ins) -> Tuple[DeviceDelta, dict]:
 
 
 def join_core(op: Join, K: int, R: int, odtype, state,
-              da: DeviceDelta, db: DeviceDelta,
+              da: Optional[DeviceDelta], db: Optional[DeviceDelta],
               key_offset=0) -> Tuple[DeviceDelta, dict]:
     """The join kernel over a (possibly per-shard) key range.
 
     ``da``/``db`` carry keys LOCAL to this range ``[0, K)``;
     ``key_offset`` maps them back to global ids on emitted rows and in the
     arguments handed to ``merge`` (the sharded path passes the shard base;
-    single-device passes 0).
+    single-device passes 0). A ``None`` side is *statically* absent: the
+    corresponding product, fold, and append are not traced at all — a tick
+    that only delivers right-side deltas (the steady churn shape) never
+    sweeps the arena, and a loop pass with no right deltas never appends.
     """
 
     def merge_v(keys, va, vb):
         out = op.merge(keys + key_offset, va, vb)
         return jnp.asarray(out, odtype)
 
-    # split δA into its retract / insert halves, scattered dense
-    wa = da.weights
-    ret_keys = jnp.where(wa < 0, da.keys, K)
-    ins_keys = jnp.where(wa > 0, da.keys, K)
-    zero_val = jnp.zeros((K,) + da.values.shape[1:], da.values.dtype)
-    zero_w = jnp.zeros((K,), jnp.int32)
-    dval_r = zero_val.at[ret_keys].set(da.values, mode="drop")
-    dw_r = zero_w.at[ret_keys].set(wa, mode="drop")
-    dval_i = zero_val.at[ins_keys].set(da.values, mode="drop")
-    dw_i = zero_w.at[ins_keys].set(wa, mode="drop")
-
-    # δA ⋈ B_old : pure gather over the arena (the SpMV)
     ak, av, aw = state["rkeys"], state["rvals"], state["rw"]
+    lval, lw = state["lval"], state["lw"]
     outs = []
-    for tab, dw in ((dval_r, dw_r), (dval_i, dw_i)):
-        w = dw[ak] * aw
-        vals = merge_v(ak, tab[ak], av)
-        outs.append(DeviceDelta(ak + key_offset, vals, w))
 
-    # fold δA into the left table
-    lw = state["lw"].at[da.keys].add(wa)
-    lval = state["lval"].at[ins_keys].set(da.values, mode="drop")
+    if da is not None:
+        # split δA into its retract / insert halves, scattered dense
+        wa = da.weights
+        ret_keys = jnp.where(wa < 0, da.keys, K)
+        ins_keys = jnp.where(wa > 0, da.keys, K)
+        zero_val = jnp.zeros((K,) + da.values.shape[1:], da.values.dtype)
+        zero_w = jnp.zeros((K,), jnp.int32)
+        dval_r = zero_val.at[ret_keys].set(da.values, mode="drop")
+        dw_r = zero_w.at[ret_keys].set(wa, mode="drop")
+        dval_i = zero_val.at[ins_keys].set(da.values, mode="drop")
+        dw_i = zero_w.at[ins_keys].set(wa, mode="drop")
 
-    # (A + δA) ⋈ δB
-    kb, vb, wb = db.keys, db.values, db.weights
-    w = lw[kb] * wb
-    vals = merge_v(kb, lval[kb], vb)
-    outs.append(DeviceDelta(kb + key_offset, vals, w))
+        # δA ⋈ B_old : pure gather over the arena (the SpMV)
+        for tab, dw in ((dval_r, dw_r), (dval_i, dw_i)):
+            w = dw[ak] * aw
+            vals = merge_v(ak, tab[ak], av)
+            outs.append(DeviceDelta(ak + key_offset, vals, w))
 
-    # append δB to the arena (compacted: live rows first)
-    liveb = wb != 0
-    rank = jnp.cumsum(liveb.astype(jnp.int32)) - 1
-    pos = jnp.where(liveb, state["rcount"] + rank, R)
-    rkeys = ak.at[pos].set(kb, mode="drop")
-    rvals = av.at[pos].set(vb, mode="drop")
-    rw = aw.at[pos].set(wb, mode="drop")
-    rcount = state["rcount"] + jnp.sum(liveb.astype(jnp.int32))
+        # fold δA into the left table
+        lw = lw.at[da.keys].add(wa)
+        lval = lval.at[ins_keys].set(da.values, mode="drop")
+
+    rkeys, rvals, rw, rcount = ak, av, aw, state["rcount"]
+    if db is not None:
+        # (A + δA) ⋈ δB
+        kb, vb, wb = db.keys, db.values, db.weights
+        w = lw[kb] * wb
+        vals = merge_v(kb, lval[kb], vb)
+        outs.append(DeviceDelta(kb + key_offset, vals, w))
+
+        # append δB to the arena (compacted: live rows first)
+        liveb = wb != 0
+        rank = jnp.cumsum(liveb.astype(jnp.int32)) - 1
+        pos = jnp.where(liveb, state["rcount"] + rank, R)
+        rkeys = ak.at[pos].set(kb, mode="drop")
+        rvals = av.at[pos].set(vb, mode="drop")
+        rw = aw.at[pos].set(wb, mode="drop")
+        rcount = state["rcount"] + jnp.sum(liveb.astype(jnp.int32))
 
     out = DeviceDelta(
         jnp.concatenate([o.keys for o in outs]),
@@ -421,6 +430,10 @@ def _lower_knn(op, node: Node, state, ins) -> Tuple[DeviceDelta, dict]:
     from reflow_tpu.kernels.topk import NEG, chunked_corpus_topk, topk
 
     dq, dd = ins
+    if dq is None:
+        dq = DeviceDelta.empty(node.inputs[0].spec)
+    if dd is None:
+        dd = DeviceDelta.empty(node.inputs[1].spec)
     Q = node.inputs[0].spec.key_space
     D = node.inputs[1].spec.key_space
     k = op.k

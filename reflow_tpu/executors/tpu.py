@@ -34,18 +34,25 @@ __all__ = ["TpuExecutor"]
 class TpuExecutor(Executor):
     name = "tpu"
 
-    def __init__(self, *, fixpoint: bool = True):
+    def __init__(self, *, fixpoint: bool = True, linear_fixpoint: bool = True):
         super().__init__()
         self._cache: Dict[tuple, object] = {}
         self._arena_used: Dict[int, int] = {}  # join node id -> host upper bound
         #: lower whole ticks of iterative graphs to one lax.while_loop
         #: program (False forces the host-driven per-pass loop)
         self.fixpoint = fixpoint
+        #: allow the fused delta-vector loop for declared-linear regions
+        #: (False forces the row-based while_loop program)
+        self.linear_fixpoint = linear_fixpoint
         self._fx_structure = None
         self._fx_unsupported = not fixpoint
         #: mesh size for sharded subclasses: arena overflow is bounded
         #: against the per-shard slice (worst-case key skew)
         self._arena_divisor = 1
+        #: sharded subclasses disable the fused delta-vector loop until
+        #: they grow a shard-aware variant (the row-based fixpoint shards)
+        self._linear_fixpoint = linear_fixpoint and type(self) is TpuExecutor
+        self._linear_structure = None
 
     # -- bind: validate lowerability, build device state -------------------
 
@@ -57,6 +64,9 @@ class TpuExecutor(Executor):
             self._cache.clear()
             self._fx_structure = None
             self._fx_unsupported = not self.fixpoint
+            self._linear_structure = None
+            self._linear_fixpoint = (self.linear_fixpoint
+                                     and type(self) is TpuExecutor)
         self.graph = graph
         self.states = {}
         self._arena_used.clear()
@@ -151,7 +161,7 @@ class TpuExecutor(Executor):
         compiled program. Returns ``(sink_batches, passes, loop_rows,
         quiesced)`` or None when the graph doesn't fit the on-device
         structure (the scheduler then uses its host-driven loop)."""
-        from reflow_tpu.executors.fixpoint import FixpointProgram, analyze
+        from reflow_tpu.executors.fixpoint import analyze
 
         if self._fx_unsupported:
             return None
@@ -168,11 +178,8 @@ class TpuExecutor(Executor):
                tuple(sorted(caps.items())), max_iters)
         prog = self._cache.get(sig)
         if prog is None:
-            try:
-                prog = FixpointProgram(self, plan, caps, max_iters,
-                                       structure=self._fx_structure)
-            except ValueError:
-                self._fx_unsupported = True
+            prog = self._build_fixpoint(plan, caps, max_iters)
+            if prog is None:
                 return None
             self._cache[sig] = prog
 
@@ -195,6 +202,38 @@ class TpuExecutor(Executor):
                        if iters > 0 else set())
         return ({sid: list(batches) for sid, batches in sink_egress.items()},
                 passes, int(rows), bool(converged), extra_dirty)
+
+    def _build_fixpoint(self, plan, caps, max_iters):
+        """Pick the fused delta-vector program when the region's operator
+        chain is declared linear; otherwise the row-based while_loop.
+        Returns None (and disables fixpoint fusion) when neither fits."""
+        from reflow_tpu.executors.fixpoint import FixpointProgram
+        from reflow_tpu.executors.linear_fixpoint import (
+            LinearFixpointProgram, analyze_linear)
+
+        if self._linear_fixpoint:
+            if self._linear_structure is None:
+                self._linear_structure = analyze_linear(
+                    self.graph, self._fx_structure)
+                if self._linear_structure is None:
+                    self._linear_fixpoint = False
+            if self._linear_structure is not None:
+                try:
+                    return LinearFixpointProgram(
+                        self, plan, caps, max_iters,
+                        structure=self._fx_structure,
+                        linear=self._linear_structure)
+                except ValueError:
+                    # shapes don't fit the fused-f32 representation; use
+                    # the row-based program below
+                    self._linear_fixpoint = False
+                    self._linear_structure = None
+        try:
+            return FixpointProgram(self, plan, caps, max_iters,
+                                   structure=self._fx_structure)
+        except ValueError:
+            self._fx_unsupported = True
+            return None
 
     def materialize(self, batch) -> DeltaBatch:
         if isinstance(batch, DeviceDelta):
@@ -265,11 +304,13 @@ class TpuExecutor(Executor):
                         f"{node}: join arena may overflow "
                         f"({self._arena_used[node.id]} appended rows vs "
                         f"per-shard capacity {cap}); raise arena_capacity")
+                # an absent left delta skips the arena sweep entirely;
                 # sharded: each of the n shards emits 2*R/n + caps[1] rows
                 # (the right delta is all_gather'd), so global egress is
                 # 2*R + n*caps[1]
-                outs_cap[node.id] = (2 * node.op.arena_capacity +
-                                     self._arena_divisor * caps[1])
+                outs_cap[node.id] = (
+                    (2 * node.op.arena_capacity if caps[0] else 0) +
+                    self._arena_divisor * caps[1])
             elif node.op.kind == "reduce":
                 K = node.inputs[0].spec.key_space
                 outs_cap[node.id] = 2 * K if caps[0] >= K else 2 * caps[0]
@@ -315,8 +356,9 @@ class TpuExecutor(Executor):
                 ins = [outs.get(i.id) for i in node.inputs]
                 if all(x is None for x in ins):
                     continue
-                ins = [x if x is not None else DeviceDelta.empty(i.spec)
-                       for x, i in zip(ins, node.inputs)]
+                # absent inputs stay None: lowerings skip the corresponding
+                # work entirely (trace-static), e.g. a Join with no left
+                # delta never sweeps its arena
                 out, st = self._lower(node, new_states.get(node.id), ins)
                 if st is not None:
                     new_states[node.id] = st

@@ -138,8 +138,9 @@ class FlowGraph:
         return self._add(name, "op", op, inputs, out)
 
     def map(self, input: Node, fn: Callable, *, vectorized: bool = False,
-            name: Optional[str] = None, spec: Optional[Spec] = None) -> Node:
-        op = Map(fn, vectorized=vectorized, out_spec=spec)
+            linear: bool = False, name: Optional[str] = None,
+            spec: Optional[Spec] = None) -> Node:
+        op = Map(fn, vectorized=vectorized, linear=linear, out_spec=spec)
         return self.add_op(op, [input], name=name)
 
     def filter(self, input: Node, pred: Callable, *, vectorized: bool = False,
@@ -159,8 +160,10 @@ class FlowGraph:
 
     def join(self, left: Node, right: Node, merge: Optional[Callable] = None,
              *, name: Optional[str] = None, spec: Optional[Spec] = None,
-             arena_capacity: int = 1 << 16) -> Node:
-        op = Join(merge, out_spec=spec, arena_capacity=arena_capacity)
+             arena_capacity: int = 1 << 16,
+             linear_left: bool = False) -> Node:
+        op = Join(merge, out_spec=spec, arena_capacity=arena_capacity,
+                  linear_left=linear_left)
         return self.add_op(op, [left, right], name=name)
 
     def union(self, *inputs: Node, name: Optional[str] = None) -> Node:

@@ -57,9 +57,14 @@ class Map(Op):
     kind = "map"
 
     def __init__(self, fn: Callable, *, vectorized: bool = False,
-                 out_spec: Optional[Spec] = None):
+                 linear: bool = False, out_spec: Optional[Spec] = None):
         self.fn = fn
         self.vectorized = vectorized
+        #: declares fn linear (fn(a·x + b·y) == a·fn(x) + b·fn(y), so
+        #: fn(0) == 0). Enables the fused delta-vector fixpoint lowering
+        #: for loop regions whose operator chain is linear end to end
+        #: (see executors/linear_fixpoint.py).
+        self.linear = linear
         self._out_spec = out_spec
 
     def out_spec(self, in_specs):
@@ -319,12 +324,20 @@ class Join(Op):
     arity = 2
 
     def __init__(self, merge: Optional[Callable] = None, *,
-                 out_spec: Optional[Spec] = None, arena_capacity: int = 1 << 16):
+                 out_spec: Optional[Spec] = None, arena_capacity: int = 1 << 16,
+                 linear_left: bool = False):
         self.merge = merge
         self._out_spec = out_spec
         #: device-path right-side arena capacity (rows); the TPU executor
         #: stores the right collection as a fixed-size append log.
         self.arena_capacity = arena_capacity
+        #: declares ``merge(k, va, vb)`` linear in ``va`` (so
+        #: ``merge(k, 0, vb)`` zeroes every va-dependent component), and —
+        #: if a GroupBy consumes this join — that its ``key_fn``/any
+        #: va-independent uses read only components that survive
+        #: ``merge(k, 0, vb)`` unchanged. Enables the fused delta-vector
+        #: fixpoint lowering (executors/linear_fixpoint.py).
+        self.linear_left = linear_left
 
     def out_spec(self, in_specs):
         if self._out_spec is not None:

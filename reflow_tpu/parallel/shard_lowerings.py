@@ -101,10 +101,14 @@ def _lower_join_sharded(op, node: Node, state, ins, axis: str, n: int
     base = (jax.lax.axis_index(axis) * Kl).astype(jnp.int32)
 
     # deltas are small: gather both sides everywhere, keep only owned rows
-    da_g = jax.tree.map(lambda x: jax.lax.all_gather(x, axis, tiled=True), da)
-    db_g = jax.tree.map(lambda x: jax.lax.all_gather(x, axis, tiled=True), db)
-    da_l = _localize(da_g, base, Kl)
-    db_l = _localize(db_g, base, Kl)
+    def _route(d):
+        if d is None:
+            return None
+        g = jax.tree.map(lambda x: jax.lax.all_gather(x, axis, tiled=True), d)
+        return _localize(g, base, Kl)
+
+    da_l = _route(da)
+    db_l = _route(db)
 
     # per-shard scalar append counter is stored as a length-1 slice of a
     # mesh-length vector; the core kernel wants a scalar

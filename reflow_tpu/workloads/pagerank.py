@@ -68,12 +68,16 @@ def build_graph(n_nodes: int, *, damping: float = DAMPING, tol: float = 1e-4,
     j = g.join(
         ranks, edges, merge=_contrib_merge, spec=edge_spec, name="contribs",
         arena_capacity=arena_capacity or max(1 << 10, 4 * n_nodes),
+        # merge is linear in rank and the GroupBy key (dst) comes from the
+        # edge side only: the TPU executor fuses the loop into the
+        # delta-vector frontier push (executors/linear_fixpoint.py)
+        linear_left=True,
     )
     by_dst = g.group_by(
         j, key_fn=lambda k, v: v[0], value_fn=lambda k, v: v[1],
         spec=scalar, name="by_dst")
     damped = g.map(by_dst, lambda v: damping * v, vectorized=True,
-                   name="damp")
+                   linear=True, name="damp")
     everything = g.union(teleport, damped, name="teleport_plus_contribs")
     new_rank = g.reduce(everything, "sum", tol=tol, name="rank",
                         spec=rank_spec)

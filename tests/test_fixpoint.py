@@ -37,11 +37,22 @@ def _ranks_arr(sched, pg):
 def test_fixpoint_used_and_matches_host_driven():
     s_fx, pg_fx, r_fx = _run(TpuExecutor(fixpoint=True))
     s_host, pg_host, r_host = _run(TpuExecutor(fixpoint=False))
-    # the fused tick reports its iterations as passes but dispatches once;
-    # both paths must quiesce and agree exactly on the converged table
-    assert all(r.quiesced for r in r_fx + r_host)
+    # a row-based while_loop tick, with the fused delta-vector program
+    # disabled (PageRank declares a linear region, so fixpoint=True now
+    # selects LinearFixpointProgram by default)
+    ex_row = TpuExecutor(fixpoint=True, linear_fixpoint=False)
+    s_row, pg_row, r_row = _run(ex_row)
+    assert all(r.quiesced for r in r_fx + r_host + r_row)
+    # all three are tol-converged fixpoints; distinct accumulation orders
+    # bound their spread by ~tol/(1-damping) plus f32 noise
+    bound = TOL / (1.0 - pagerank.DAMPING) + 1e-5
     np.testing.assert_allclose(
-        _ranks_arr(s_fx, pg_fx), _ranks_arr(s_host, pg_host), atol=1e-6)
+        _ranks_arr(s_fx, pg_fx), _ranks_arr(s_host, pg_host), atol=bound)
+    np.testing.assert_allclose(
+        _ranks_arr(s_fx, pg_fx), _ranks_arr(s_row, pg_row), atol=bound)
+    # the fused program was actually selected on the default path
+    assert s_fx.executor._linear_structure is not None
+    assert s_row.executor._linear_structure is None
 
 
 def test_fixpoint_matches_numpy_reference_after_churn():
