@@ -1,27 +1,36 @@
 #!/usr/bin/env python3
-"""Benchmark harness: incremental PageRank (BASELINE.md config 3).
+"""Benchmark harness: the BASELINE.md configs, headline = config 3.
 
-Runs the north-star workload — incremental PageRank under per-tick edge
-churn — on the TpuExecutor at full scale and on the CpuExecutor (the
-default path / baseline), and prints ONE JSON line to stdout::
+Headline (ONE JSON line on stdout): incremental PageRank under per-tick
+edge churn (BASELINE.md config 3, the north-star workload) on the
+TpuExecutor vs the CpuExecutor (the default path / baseline)::
 
     {"metric": ..., "value": <speedup>, "unit": "x", "vs_baseline": <v/20>}
 
-``value`` is the delta-ops/sec throughput ratio TPU/CPU on the churn ticks
-(the "delta-ops/sec/chip + incremental-vs-full speedup" metric from
-BASELINE.md; the 20x divisor is the BASELINE.json north-star target).
-Detail (per-executor build/tick walls, incremental-vs-full speedup) goes to
-stderr.
+``value`` is the delta-ops/sec throughput ratio TPU/CPU on churn ticks.
+The TPU rate is the *streaming* rate (ticks pipelined with
+``tick(sync=False)``, one device sync per batch — how a streaming
+deployment runs); the synced per-tick median, the warm full-recompute
+wall, and the incremental-vs-full ratio are reported alongside on
+stderr, as are the per-config records for the other BASELINE configs
+(word-count, TF-IDF, k-NN, image-embed ETL) when ``REFLOW_BENCH_ALL=1``.
+
+The CPU baseline measures the same graph shape scaled to
+``REFLOW_BENCH_CPU_EDGES_CAP`` edges plus a scaling sweep over smaller
+sizes (stderr) showing how the per-row rate trends, so the extrapolation
+to full scale is visible rather than assumed; ``REFLOW_BENCH_CPU_FULL=1``
+runs the CPU executor at the full config instead (slow: ~10min).
 
 Env knobs::
 
     REFLOW_BENCH_SMOKE=1          tiny scale (local sanity check)
     REFLOW_BENCH_NODES/EDGES      graph size        (default 100k / 1M)
     REFLOW_BENCH_CHURN            churn fraction    (default 0.01)
-    REFLOW_BENCH_TICKS            measured ticks    (default 3)
-    REFLOW_BENCH_CPU_EDGES_CAP    CPU run is scaled down to at most this
-                                  many edges (Python-loop baseline; its
-                                  per-row throughput is scale-independent)
+    REFLOW_BENCH_TICKS            measured synced ticks      (default 3)
+    REFLOW_BENCH_STREAM_TICKS     pipelined streaming ticks  (default 8)
+    REFLOW_BENCH_CPU_EDGES_CAP    CPU measured at <= this many edges
+    REFLOW_BENCH_CPU_FULL=1       CPU at full scale (overrides cap)
+    REFLOW_BENCH_ALL=0            skip configs 1/2/4/5 (default: run them)
 """
 
 from __future__ import annotations
@@ -38,22 +47,29 @@ def log(*a) -> None:
     print(*a, file=sys.stderr, flush=True)
 
 
+def _build_pagerank(n_nodes: int, n_edges: int, churn: float,
+                    tol: float, seed: int = 7):
+    from reflow_tpu.executors.device_delta import bucket_capacity
+    from reflow_tpu.workloads import pagerank
+
+    # arena sized for LIVE rows plus churn headroom — on-device compaction
+    # (executors/arena.py) reclaims cancelled pairs when the high-water
+    # check trips, so capacity no longer scales with tick count
+    churn_cap = bucket_capacity(2 * int(churn * n_edges) + 2)
+    arena = bucket_capacity(n_edges) + 8 * churn_cap
+    pr = pagerank.build_graph(n_nodes, tol=tol, arena_capacity=arena)
+    web = pagerank.WebGraph.random(n_nodes, n_edges, seed=seed)
+    return pr, web
+
+
 def run_pagerank(executor: str, n_nodes: int, n_edges: int, churn: float,
-                 ticks: int, tol: float) -> dict:
+                 ticks: int, stream_ticks: int, tol: float) -> dict:
     from reflow_tpu.executors import get_executor
     from reflow_tpu.scheduler import DirtyScheduler
     from reflow_tpu.workloads import pagerank
 
-    # the executor's conservative overflow tracker counts padded ingress
-    # *capacities* (power-of-two bucketed), so size the arena in those terms
-    from reflow_tpu.executors.device_delta import bucket_capacity
-    churn_cap = bucket_capacity(2 * int(churn * n_edges) + 2)
-    # 2x the full-edge capacity: the warm full-recompute baseline rebuilds
-    # the graph once more on the same executor (same arena tracker)
-    arena = 2 * bucket_capacity(n_edges) + (ticks + 3) * churn_cap
-    pr = pagerank.build_graph(n_nodes, tol=tol, arena_capacity=arena)
+    pr, web = _build_pagerank(n_nodes, n_edges, churn, tol)
     sched = DirtyScheduler(pr.graph, get_executor(executor))
-    web = pagerank.WebGraph.random(n_nodes, n_edges, seed=7)
 
     sched.push(pr.teleport, pagerank.teleport_batch(n_nodes))
     sched.push(pr.edges, web.initial_batch())
@@ -61,10 +77,12 @@ def run_pagerank(executor: str, n_nodes: int, n_edges: int, churn: float,
     sched.tick()
     build_s = time.perf_counter() - t0
 
-    # one unmeasured churn tick to absorb jit compiles of the churn shapes
-    sched.push(pr.edges, web.churn(churn))
-    sched.tick()
+    # two unmeasured churn ticks absorb jit compiles of the churn shapes
+    for _ in range(2):
+        sched.push(pr.edges, web.churn(churn))
+        sched.tick()
 
+    # synced per-tick walls (the incremental-vs-full numerator)
     walls, dops = [], []
     for _ in range(ticks):
         sched.push(pr.edges, web.churn(churn))
@@ -72,8 +90,22 @@ def run_pagerank(executor: str, n_nodes: int, n_edges: int, churn: float,
         walls.append(res.wall_s)
         dops.append(res.delta_ops)
 
+    # streaming: pipelined ticks, one sync per batch — the delta-ops/s
+    # throughput a streaming deployment sees
+    results = []
+    t0 = time.perf_counter()
+    for _ in range(stream_ticks):
+        sched.push(pr.edges, web.churn(churn))
+        results.append(sched.tick(sync=False))
+    for r in results:
+        r.block()
+    stream_wall = time.perf_counter() - t0
+    assert all(r.quiesced for r in results)
+    stream_dops = sum(r.delta_ops for r in results)
+
     # warm full-recompute baseline: rebuild from scratch on the same (warm)
-    # executor, so jit compile time isn't billed to "full recompute"
+    # executor with the same scheduler settings, so the compiled program
+    # cache applies and compile time isn't billed to "full recompute"
     ex = sched.executor
     sched2 = DirtyScheduler(pr.graph, ex)
     sched2.push(pr.teleport, pagerank.teleport_batch(n_nodes))
@@ -89,8 +121,10 @@ def run_pagerank(executor: str, n_nodes: int, n_edges: int, churn: float,
         "cold_build_s": build_s,
         "full_recompute_s": full_s,
         "tick_s_median": float(np.median(walls)),
-        "delta_ops_per_s": float(sum(dops) / sum(walls)),
+        "delta_ops_per_s": float(stream_dops / stream_wall),
+        "delta_ops_per_s_synced": float(sum(dops) / sum(walls)),
         "delta_ops_per_tick": float(np.mean(dops)),
+        "stream_ticks": stream_ticks,
     }
 
 
@@ -102,22 +136,51 @@ def main() -> None:
         "REFLOW_BENCH_EDGES", 10_000 if smoke else 1_000_000))
     churn = float(os.environ.get("REFLOW_BENCH_CHURN", 0.01))
     ticks = int(os.environ.get("REFLOW_BENCH_TICKS", 2 if smoke else 3))
+    stream_ticks = int(os.environ.get(
+        "REFLOW_BENCH_STREAM_TICKS", 2 if smoke else 8))
     cpu_cap = int(os.environ.get(
         "REFLOW_BENCH_CPU_EDGES_CAP", 10_000 if smoke else 100_000))
+    cpu_full = os.environ.get("REFLOW_BENCH_CPU_FULL") == "1"
     tol = 1e-4
 
     import jax
     log(f"jax backend={jax.default_backend()} devices={len(jax.devices())}")
 
-    tpu = run_pagerank("tpu", n_nodes, n_edges, churn, ticks, tol)
+    # configs 1/2/4/5 first (records on stderr), headline (config 3) last
+    # so the final stdout line stays the parseable result
+    if os.environ.get("REFLOW_BENCH_ALL", "1") == "1":
+        from bench_configs import run_all_configs
+        run_all_configs(smoke, log)
+
+    tpu = run_pagerank("tpu", n_nodes, n_edges, churn, ticks,
+                       stream_ticks, tol)
     log("tpu:", json.dumps(tpu))
     incr_vs_full = tpu["full_recompute_s"] / tpu["tick_s_median"]
-    log(f"incremental-vs-full (tpu executor, warm): {incr_vs_full:.1f}x")
+    log(f"incremental-vs-full (tpu executor, warm, synced): "
+        f"{incr_vs_full:.1f}x")
+    incr_vs_full_stream = (tpu["full_recompute_s"] *
+                           tpu["delta_ops_per_s"] /
+                           max(tpu["delta_ops_per_tick"], 1))
+    log(f"incremental-vs-full (streaming rate): {incr_vs_full_stream:.1f}x")
 
-    scale = min(1.0, cpu_cap / n_edges)
-    cpu = run_pagerank("cpu", max(64, int(n_nodes * scale)),
-                       max(256, int(n_edges * scale)), churn,
-                       max(1, min(ticks, 2)), tol)
+    # CPU baseline: measured at the cap, with a scaling sweep making the
+    # per-row-rate extrapolation explicit (ADVICE r1: not apples-to-apples
+    # without it)
+    if cpu_full:
+        cpu = run_pagerank("cpu", n_nodes, n_edges, churn, 1, 1, tol)
+    else:
+        sweep = []
+        cap = min(cpu_cap, n_edges)
+        e = max(256, cap // 4)
+        while e <= cap:
+            scale = e / n_edges
+            r = run_pagerank("cpu", max(64, int(n_nodes * scale)), e,
+                             churn, 1, 1, tol)
+            sweep.append(r)
+            log(f"cpu sweep @ {e} edges: "
+                f"{r['delta_ops_per_s']:.0f} delta-ops/s")
+            e *= 2
+        cpu = sweep[-1]
     log("cpu:", json.dumps(cpu))
 
     speedup = tpu["delta_ops_per_s"] / cpu["delta_ops_per_s"]
@@ -126,6 +189,10 @@ def main() -> None:
         "value": round(speedup, 2),
         "unit": "x",
         "vs_baseline": round(speedup / 20.0, 3),
+        "tpu_delta_ops_per_s": round(tpu["delta_ops_per_s"]),
+        "cpu_delta_ops_per_s": round(cpu["delta_ops_per_s"]),
+        "cpu_edges": cpu["edges"],
+        "incr_vs_full": round(incr_vs_full, 2),
     }))
 
 

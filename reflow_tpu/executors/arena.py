@@ -1,0 +1,82 @@
+"""Join-arena compaction (GC): bound the arena by LIVE rows, not lifetime.
+
+The device Join stores its right side as an append-only log: retractions
+append negative-weight rows rather than freeing their match, so without
+reclamation ``arena_capacity`` must cover the *lifetime* append count and
+a long-running stream eventually dies on the overflow check (round-1
+VERDICT item 7).
+
+``compact_arena`` cancels matched pairs on device: rows are lex-sorted by
+(key, value bytes), equal (key, value) runs are weight-summed, and groups
+with net weight 0 vanish; survivors are repacked to the front with their
+net weight. Exactness contract: a retraction carries the SAME value bytes
+as the insert it cancels (true by construction for host-driven deltas —
+the retract batch replays the original row with weight -1; float values
+are compared bitwise, so NaNs and signed zeros cancel only their
+bit-identical twins).
+
+The executor triggers compaction from its host-side high-water check
+(``_track_arena``): when planned appends would cross capacity, compact
+first, refresh the tracker from the true occupancy (one scalar readback),
+and only fail if the arena is genuinely full of live rows. Sharded
+executors run the same kernel per shard under ``shard_map`` (rows never
+migrate; each shard's occupancy counter is its slice of ``rcount``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["compact_arena"]
+
+
+def compact_arena(state: dict) -> dict:
+    """Pure kernel: (join state) -> (join state with arena compacted).
+
+    Only the arena fields (rkeys/rvals/rw/rcount) change; the left table
+    passes through untouched. Shapes are static; runs under jit or as a
+    shard_map body.
+    """
+    rk, rv, rw = state["rkeys"], state["rvals"], state["rw"]
+    R = rk.shape[0]
+    vcols = rv.reshape(R, -1)
+    # bitwise value identity: compare float payloads as int bit patterns
+    if jnp.issubdtype(vcols.dtype, jnp.floating):
+        bits = jax.lax.bitcast_convert_type(
+            vcols.astype(jnp.float32), jnp.int32)
+    else:
+        bits = vcols.astype(jnp.int32)
+    live = rw != 0
+    skey = jnp.where(live, rk, jnp.iinfo(jnp.int32).max)
+
+    # lex order: key primary, then value columns (np.lexsort: LAST key is
+    # primary)
+    order = jnp.lexsort(tuple(bits[:, q] for q in range(bits.shape[1] - 1,
+                                                        -1, -1)) + (skey,))
+    sk = skey[order]
+    sb = bits[order]
+    sv = rv[order]
+    sw = rw[order]
+
+    prev_same = jnp.concatenate([
+        jnp.zeros((1,), jnp.bool_),
+        (sk[1:] == sk[:-1]) & jnp.all(sb[1:] == sb[:-1], axis=-1),
+    ])
+    first = ~prev_same
+    gid = jnp.cumsum(first.astype(jnp.int32)) - 1
+    netw = jnp.zeros((R,), jnp.int32).at[gid].add(sw)
+    keep = first & (netw[gid] != 0) & (sk != jnp.iinfo(jnp.int32).max)
+
+    pos = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    tgt = jnp.where(keep, pos, R)
+    nk = jnp.zeros_like(rk).at[tgt].set(sk, mode="drop")
+    nv = jnp.zeros_like(rv).at[tgt].set(sv, mode="drop")
+    nw = jnp.zeros_like(rw).at[tgt].set(netw[gid], mode="drop")
+    ncount = jnp.sum(keep.astype(jnp.int32))
+
+    out = dict(state)
+    out.update(rkeys=nk, rvals=nv, rw=nw,
+               rcount=jnp.broadcast_to(ncount, state["rcount"].shape
+                                       ).astype(state["rcount"].dtype))
+    return out

@@ -46,13 +46,19 @@ class Executor(abc.ABC):
         ...
 
     def run_tick_fixpoint(self, plan: Sequence[Node],
-                          ingress: Dict[int, DeltaBatch], max_iters: int):
+                          ingress: Dict[int, DeltaBatch], max_iters: int,
+                          *, sync: bool = True):
         """Optionally run an ENTIRE tick (all fixpoint passes) in one call.
 
         Returns ``({sink_id: [batches]}, passes, loop_rows, quiesced,
         extra_dirty_node_ids)`` or None when unsupported — the scheduler
         then drives passes itself. Executors that can fuse the loop on
         device (TpuExecutor via ``lax.while_loop``) override this.
+
+        ``sync=False`` permits the scalar observability fields (passes,
+        loop_rows, quiesced) to come back as device values without
+        blocking — streaming callers pipeline ticks and block once per
+        batch (see ``TickResult.block``).
         """
         return None
 

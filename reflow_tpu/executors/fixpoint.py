@@ -70,6 +70,13 @@ def analyze(graph: FlowGraph) -> Optional[FixpointStructure]:
         return None
     region = graph.loop_region()
     region_ids = frozenset(n.id for n in region)
+    for node in region:
+        if (node.kind == "op" and node.op.kind == "join"
+                and node.inputs[1].id in region_ids):
+            # a loop-carried right (arena) input appends rows every
+            # while_loop iteration, invisibly to the host-side overflow
+            # tracker — only the host-driven loop tracks those (ADVICE r1)
+            return None
     boundary = []
     for node in region:
         if any(c.id not in region_ids for c, _ in graph.consumers(node)):

@@ -173,10 +173,13 @@ def _rowfn(fn: Callable, vectorized: bool) -> Callable:
 
 def _edge_budget_tiers(arena_capacity: int) -> List[int]:
     """Static gather budgets, large to small; the dense full-arena branch
-    sits above the largest. Ratio-4 steps bound wasted gather slots to 4x
-    the live frontier while keeping the lax.switch small."""
+    sits above the largest. A budget pass costs ~2.5x more per row than a
+    dense sweep (compaction + ragged indirection), so budgets above
+    arena/8 never win — the largest tier starts there. Ratio-4 steps
+    bound wasted gather slots to 4x the live frontier while keeping the
+    lax.switch small."""
     tiers = []
-    c = 1 << (max(arena_capacity // 2, 1).bit_length() - 1)
+    c = 1 << (max(arena_capacity // 8, 1).bit_length() - 1)
     while c >= 2048 and len(tiers) < 6:
         tiers.append(c)
         c //= 4

@@ -156,11 +156,16 @@ class TpuExecutor(Executor):
     # -- whole-tick on-device fixpoint (SURVEY.md §7.9, hard part e) -------
 
     def run_tick_fixpoint(self, plan: Sequence[Node],
-                          ingress: Dict[int, DeltaBatch], max_iters: int):
+                          ingress: Dict[int, DeltaBatch], max_iters: int,
+                          *, sync: bool = True):
         """Run an entire tick (initial pass + fixpoint + exit pass) as one
         compiled program. Returns ``(sink_batches, passes, loop_rows,
         quiesced)`` or None when the graph doesn't fit the on-device
-        structure (the scheduler then uses its host-driven loop)."""
+        structure (the scheduler then uses its host-driven loop).
+
+        With ``sync=False`` the scalar tick metadata stays device-resident
+        (no readback, so pipelined ticks enqueue back-to-back); the dirty
+        set is then reported conservatively (as if the loop iterated)."""
         from reflow_tpu.executors.fixpoint import analyze
 
         if self._fx_unsupported:
@@ -193,15 +198,23 @@ class TpuExecutor(Executor):
         new_states, sink_egress, iters, rows, converged = prog(
             dict(self.states), dev_ingress)
         self.states = new_states
-        iters = int(iters)
-        passes = 1 + iters + (1 if st.exit_plan else 0)
+        exit_passes = 1 if st.exit_plan else 0
+        if sync:
+            iters = int(iters)
+            passes = 1 + iters + exit_passes
+            rows = int(rows)
+            converged = bool(converged)
+            looped = iters > 0
+        else:
+            passes = 1 + iters + exit_passes  # device scalar; no readback
+            looped = True  # conservative dirty-set report
         # nodes the fused passes executed beyond the phase-A plan (for the
         # scheduler's dirty-set observability): region + exit nodes, which
         # only ran if the loop actually iterated
         extra_dirty = (set(st.region_ids) | {n.id for n in st.exit_plan}
-                       if iters > 0 else set())
+                       if looped else set())
         return ({sid: list(batches) for sid, batches in sink_egress.items()},
-                passes, int(rows), bool(converged), extra_dirty)
+                passes, rows, converged, extra_dirty)
 
     def _build_fixpoint(self, plan, caps, max_iters):
         """Pick the fused delta-vector program when the region's operator
@@ -298,12 +311,18 @@ class TpuExecutor(Executor):
                 continue
             if node.op.kind == "join":
                 cap = node.op.arena_capacity // self._arena_divisor
+                if self._arena_used[node.id] + caps[1] > cap:
+                    # high water: compact the arena (cancel matched
+                    # insert/retract pairs) and refresh the tracker from
+                    # true occupancy before deciding to fail
+                    self._arena_used[node.id] = self._compact_arena(node)
                 self._arena_used[node.id] += caps[1]
                 if self._arena_used[node.id] > cap:
                     raise GraphError(
                         f"{node}: join arena may overflow "
-                        f"({self._arena_used[node.id]} appended rows vs "
-                        f"per-shard capacity {cap}); raise arena_capacity")
+                        f"({self._arena_used[node.id]} live+appended rows "
+                        f"vs per-shard capacity {cap}) even after "
+                        f"compaction; raise arena_capacity")
                 # an absent left delta skips the arena sweep entirely;
                 # sharded: each of the n shards emits 2*R/n + caps[1] rows
                 # (the right delta is all_gather'd), so global egress is
@@ -320,6 +339,28 @@ class TpuExecutor(Executor):
                 outs_cap[node.id] = sum(caps)
             else:
                 outs_cap[node.id] = caps[0]
+
+    def _gc_fn(self):
+        """The (cached) compiled arena-compaction kernel; sharded
+        subclasses wrap it per-shard."""
+        import jax
+
+        from reflow_tpu.executors.arena import compact_arena
+
+        fn = self._cache.get("gc")
+        if fn is None:
+            fn = jax.jit(compact_arena)
+            self._cache["gc"] = fn
+        return fn
+
+    def _compact_arena(self, node: Node) -> int:
+        """Compact one Join's arena in place; returns live-row occupancy
+        (per-shard max under sharding — the tracker's bound is
+        worst-case-skew per shard)."""
+        import numpy as np
+
+        self.states[node.id] = self._gc_fn()(self.states[node.id])
+        return int(np.max(np.asarray(self.states[node.id]["rcount"])))
 
     # -- trace & compile one pass program ----------------------------------
 

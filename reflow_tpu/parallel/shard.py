@@ -86,6 +86,25 @@ class ShardedTpuExecutor(TpuExecutor):
             return P(self.axis)
         return P()
 
+    def _gc_fn(self):
+        """Per-shard arena compaction under shard_map: rows never migrate
+        between shards; each shard repacks its slice and its slot of the
+        rcount vector."""
+        import jax
+
+        from reflow_tpu.executors.arena import compact_arena
+
+        fn = self._cache.get("gc")
+        if fn is None:
+            def sharded_gc(state):
+                specs = jax.tree.map(self._state_spec, state)
+                return jax.shard_map(compact_arena, mesh=self.mesh,
+                                     in_specs=(specs,), out_specs=specs,
+                                     check_vma=False)(state)
+            fn = sharded_gc
+            self._cache["gc"] = fn
+        return fn
+
     # -- the SPMD pass program ---------------------------------------------
 
     def _lower(self, node: Node, state, ins):

@@ -1,21 +1,31 @@
 """Shard-aware op lowerings: the per-shard kernels under ``shard_map``.
 
-Design (the scaling-book recipe — gather what's small, shard what's big):
+Design (the scaling-book recipe — route rows to their key's owner, shard
+what's big):
 
 - **Map / Filter / GroupBy / Union** are local on row-sharded delta
   buffers: no communication. A GroupBy re-key leaves rows in place; routing
   happens where a *keyed* op consumes them.
-- **Reduce**: each shard scatter-adds its local delta rows into a full-K
-  contribution table, then one ``psum_scatter`` (reduce-scatter over the
-  mesh axis) hands every shard the combined contributions for its owned
-  key range — the cross-shard combine the north star names. State tables
-  (``wsum``/``wcnt``/``emitted``) live key-sharded; emission covers the
-  owned range with global key ids.
-- **Join**: per-tick deltas are small, per-key state is big — so both
-  delta sides are ``all_gather``'d (tiled), masked to the shard's owned
-  key range, localized, and fed to the shared :func:`join_core` over the
-  shard's slice of the left table and append arena. Output rows stay on
-  the owning shard (row-sharded), keys global.
+- **Row routing** (:func:`route_rows`): one ``all_to_all`` on
+  shard-of-key delivers every live delta row to the shard owning its key
+  range — traffic O(slack x delta rows), independent of both the mesh
+  size (vs all_gather's O(n x rows)) and the key space (vs a dense
+  reduce-scatter's O(K)). Static shapes force a per-destination budget
+  (``ROUTE_SLACK`` x balanced share); overflow beyond the budget sets a
+  sticky per-node error flag surfaced by ``check_errors`` — loud, never
+  silent truncation.
+- **Reduce**: sparse regime (delta capacity well under K) routes rows to
+  their owners and scatter-adds locally — per-pass comms scale with the
+  delta, not the key space. Dense regime (delta ~ K, e.g. full rebuild
+  passes) keeps the full-K contribution table + one ``psum_scatter``
+  (reduce-scatter), which is optimal when most keys are touched. State
+  tables (``wsum``/``wcnt``/``emitted``) live key-sharded; emission covers
+  the owned range with global key ids.
+- **Join**: both delta sides are routed to key owners (``all_to_all``)
+  and fed to the shared :func:`join_core` over the shard's slice of the
+  left table and append arena; meshes too small for routing to win
+  (n <= ROUTE_SLACK) keep the tiled ``all_gather`` + mask. Output rows
+  stay on the owning shard (row-sharded), keys global.
 
 Keyed state is range-sharded: shard ``i`` of ``n`` owns keys
 ``[i*K/n, (i+1)*K/n)``. Range (not hash) sharding keeps key<->shard
@@ -24,7 +34,7 @@ arithmetic trivial and lets emission use a contiguous ``arange``.
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -35,7 +45,52 @@ from reflow_tpu.executors.lowerings import (_LOWERINGS, _agg_tables,
                                             _scatter_contribs, join_core)
 from reflow_tpu.graph import Node
 
-__all__ = ["lower_node_sharded"]
+__all__ = ["lower_node_sharded", "route_rows", "ROUTE_SLACK"]
+
+#: per-destination row budget = ROUTE_SLACK x the perfectly-balanced
+#: share. 4x absorbs realistic key skew; pathological skew trips the
+#: sticky overflow flag instead of truncating.
+ROUTE_SLACK = 4
+
+
+def route_rows(d: DeviceDelta, axis: str, n: int, Kl: int,
+               slack: int = ROUTE_SLACK
+               ) -> Tuple[DeviceDelta, jax.Array]:
+    """Deliver each live row to the shard owning its key (one all_to_all).
+
+    ``d`` is this shard's local slice (capacity Cl) of a row-sharded
+    delta. Rows are bucketed by owner shard (``key // Kl``), each bucket
+    padded to the static budget ``B = ceil(slack*Cl/n)``, exchanged, and
+    returned as a local-keyed delta of capacity ``n*B`` (re-based keys,
+    weight-0 padding). Second return is the per-shard overflow flag (any
+    live row beyond its bucket's budget was NOT sent).
+    """
+    Cl = d.keys.shape[0]
+    B = max(1, -(-slack * Cl // n))
+    live = d.weights != 0
+    owner = jnp.where(live, jnp.clip(d.keys // Kl, 0, n - 1), n)
+    order = jnp.argsort(owner, stable=True)
+    so = owner[order]
+    sk, sv, sw = d.keys[order], d.values[order], d.weights[order]
+    start = jnp.searchsorted(so, jnp.arange(n, dtype=so.dtype))
+    slot = jnp.arange(Cl, dtype=jnp.int32) - start[jnp.minimum(so, n - 1)]
+    ok = (so < n) & (slot < B)
+    err = jnp.any((so < n) & (slot >= B))
+    pos = jnp.where(ok, so.astype(jnp.int32) * B + slot, n * B)
+    send_k = jnp.zeros((n * B,), jnp.int32).at[pos].set(sk, mode="drop")
+    send_v = jnp.zeros((n * B,) + d.values.shape[1:],
+                       d.values.dtype).at[pos].set(sv, mode="drop")
+    send_w = jnp.zeros((n * B,), jnp.int32).at[pos].set(sw, mode="drop")
+
+    def xchg(a):
+        trail = a.shape[1:]
+        out = jax.lax.all_to_all(a.reshape((n, B) + trail), axis, 0, 0)
+        return out.reshape((n * B,) + trail)
+
+    rk, rv, rw = xchg(send_k), xchg(send_v), xchg(send_w)
+    base = (jax.lax.axis_index(axis) * Kl).astype(jnp.int32)
+    lk = jnp.where(rw != 0, rk - base, 0)
+    return DeviceDelta(lk, rv, rw), err
 
 
 def _localize(d: DeviceDelta, base, Kl: int) -> DeviceDelta:
@@ -58,19 +113,31 @@ def _lower_reduce_sharded(op, node: Node, state, ins, axis: str, n: int
     in_spec = node.inputs[0].spec
     K = in_spec.key_space
     Kl = K // n
+    Cl = d.keys.shape[0]
     vdtype = node.spec.value_dtype
     base = (jax.lax.axis_index(axis) * Kl).astype(jnp.int32)
-
-    # local full-K contributions (one fused scatter), then one
-    # reduce-scatter hands each shard its owned range's combined sums
-    dws, dwc = _scatter_contribs(d, K)
     vshape = d.values.shape[1:]
-    stacked = jnp.concatenate(
-        [dws.reshape(K, -1), dwc.astype(jnp.float32)[:, None]], axis=-1)
-    combined = jax.lax.psum_scatter(stacked, axis, scatter_dimension=0,
-                                    tiled=True)
-    wsum = state["wsum"] + combined[:, :-1].reshape((Kl,) + vshape)
-    wcnt = state["wcnt"] + combined[:, -1].astype(jnp.int32)
+    err = state.get("error")
+
+    if ROUTE_SLACK * Cl < Kl:
+        # sparse regime: route rows to their key's owner and fold locally
+        # — comms O(slack*Cl), independent of K
+        dl, route_err = route_rows(d, axis, n, Kl)
+        dws, dwc = _scatter_contribs(dl, Kl)
+        wsum = state["wsum"] + dws
+        wcnt = state["wcnt"] + dwc
+        if err is not None:
+            err = err | (jax.lax.pmax(route_err.astype(jnp.int32), axis) > 0)
+    else:
+        # dense regime (most keys touched, e.g. rebuild passes): full-K
+        # local contributions + one reduce-scatter
+        dws, dwc = _scatter_contribs(d, K)
+        stacked = jnp.concatenate(
+            [dws.reshape(K, -1), dwc.astype(jnp.float32)[:, None]], axis=-1)
+        combined = jax.lax.psum_scatter(stacked, axis, scatter_dimension=0,
+                                        tiled=True)
+        wsum = state["wsum"] + combined[:, :-1].reshape((Kl,) + vshape)
+        wcnt = state["wcnt"] + combined[:, -1].astype(jnp.int32)
 
     # dense diff over the owned slice (mirrors _lower_reduce dense mode)
     emitted, em_has = state["emitted"], state["emitted_has"]
@@ -88,8 +155,11 @@ def _lower_reduce_sharded(op, node: Node, state, ins, axis: str, n: int
     ins_b = _bcast_w(ins_m, agg)
     new_emitted = jnp.where(ins_b, agg, emitted)
     new_has = jnp.where(ins_m, True, jnp.where(ret_m & ~exists, False, em_has))
-    return out, {"wsum": wsum, "wcnt": wcnt,
+    new_state = {"wsum": wsum, "wcnt": wcnt,
                  "emitted": new_emitted, "emitted_has": new_has}
+    if err is not None:
+        new_state["error"] = err
+    return out, new_state
 
 
 def _lower_join_sharded(op, node: Node, state, ins, axis: str, n: int

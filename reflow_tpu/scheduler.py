@@ -31,7 +31,12 @@ __all__ = ["DirtyScheduler", "TickResult"]
 
 @dataclasses.dataclass
 class TickResult:
-    """Per-tick observability record (SURVEY.md §5 metrics)."""
+    """Per-tick observability record (SURVEY.md §5 metrics).
+
+    After ``tick(sync=False)`` the scalar fields may still be
+    device-resident (pipelined streaming: nothing blocked on the device);
+    call :meth:`block` to force them to host Python values.
+    """
 
     tick: int
     sink_deltas: Dict[str, DeltaBatch]
@@ -46,6 +51,15 @@ class TickResult:
     def delta_ops(self) -> int:
         """Delta rows processed — numerator of delta-ops/sec (BASELINE.md)."""
         return self.deltas_in + self.deltas_out
+
+    def block(self) -> "TickResult":
+        """Force any device-resident scalar fields to host values (the
+        streaming sync point; a no-op for synchronous ticks)."""
+        self.passes = int(self.passes)
+        self.deltas_in = int(self.deltas_in)
+        self.deltas_out = int(self.deltas_out)
+        self.quiesced = bool(self.quiesced)
+        return self
 
 
 class DirtyScheduler:
@@ -109,7 +123,12 @@ class DirtyScheduler:
 
     # -- the tick ----------------------------------------------------------
 
-    def tick(self) -> TickResult:
+    def tick(self, *, sync: bool = True) -> TickResult:
+        """Run one tick. ``sync=False`` (streaming mode) skips the
+        per-tick device readback for iterative graphs fully fused on
+        device: ticks enqueue back-to-back and the returned TickResult's
+        scalars stay device-resident until ``block()``. Graphs with sinks
+        or host-driven loops still materialize synchronously."""
         t0 = time.perf_counter()
         ingress: Dict[int, DeltaBatch] = {
             nid: DeltaBatch.concat(batches)
@@ -134,7 +153,7 @@ class DirtyScheduler:
                 # iterative graph: let the executor fuse the entire tick
                 # (all fixpoint passes) into one on-device program
                 fx = self.executor.run_tick_fixpoint(
-                    plan, ingress, self.max_loop_iters)
+                    plan, ingress, self.max_loop_iters, sync=sync)
                 if fx is not None:
                     (sink_batches, fx_passes, loop_rows, quiesced,
                      extra_dirty) = fx
@@ -157,8 +176,12 @@ class DirtyScheduler:
 
         # fail loudly if any op state carries a sticky error flag (e.g. a
         # retraction reached an insert-only device min/max) BEFORE corrupt
-        # deltas are folded into the materialized sink views
-        self.executor.check_errors()
+        # deltas are folded into the materialized sink views. Streaming
+        # ticks (sync=False) defer the check to the next sync point —
+        # unless sink views are about to be materialized, which forces a
+        # sync anyway and must not fold corrupt deltas
+        if sync or sink_deltas:
+            self.executor.check_errors()
 
         out: Dict[str, DeltaBatch] = {}
         for name, batches in sink_deltas.items():

@@ -1,0 +1,78 @@
+"""Join-arena compaction (executors/arena.py): matched insert/retract
+pairs cancel on device, so arena_capacity bounds LIVE rows and a
+long-running churn stream survives at constant arena size (round-1
+VERDICT item 7)."""
+
+import numpy as np
+import pytest
+
+from reflow_tpu import DirtyScheduler
+from reflow_tpu.executors.device_delta import bucket_capacity
+from reflow_tpu.executors.tpu import TpuExecutor
+from reflow_tpu.workloads import pagerank
+
+
+def test_compact_arena_kernel():
+    import jax.numpy as jnp
+
+    from reflow_tpu.executors.arena import compact_arena
+
+    R = 16
+    # rows: (k=1,v=2.0,+1), (k=1,v=2.0,+1)  -> survives with net weight 2
+    #       (k=3,v=5.0,+1), (k=3,v=5.0,-1)  -> cancels
+    #       (k=4,v=7.0,-1)                  -> survives (net -1)
+    rk = jnp.zeros(R, jnp.int32).at[:5].set(jnp.array([1, 3, 1, 3, 4]))
+    rv = jnp.zeros((R, 1), jnp.float32).at[:5, 0].set(
+        jnp.array([2.0, 5.0, 2.0, 5.0, 7.0]))
+    rw = jnp.zeros(R, jnp.int32).at[:5].set(jnp.array([1, 1, 1, -1, -1]))
+    state = {"lval": jnp.zeros((8,)), "lw": jnp.zeros((8,), jnp.int32),
+             "rkeys": rk, "rvals": rv, "rw": rw,
+             "rcount": jnp.asarray(5, jnp.int32)}
+    out = compact_arena(state)
+    assert int(out["rcount"]) == 2
+    live = np.asarray(out["rw"]) != 0
+    rows = sorted(zip(np.asarray(out["rkeys"])[live].tolist(),
+                      np.asarray(out["rvals"])[live, 0].tolist(),
+                      np.asarray(out["rw"])[live].tolist()))
+    assert rows == [(1, 2.0, 2), (4, 7.0, -1)]
+
+
+@pytest.mark.parametrize("make_ex,arena_mult,ticks", [
+    (lambda: TpuExecutor(), 1, 50),
+    # the sharded tracker bounds appends by worst-case key skew (every
+    # all_gather'd row could land on one shard), so its live-row arena is
+    # n_shards x larger — lifetime appends still exceed it several-fold
+    pytest.param(lambda: _sharded(), 8, 12, id="sharded"),
+])
+def test_long_churn_constant_arena(make_ex, arena_mult, ticks):
+    """50 churn ticks through an arena sized for LIVE rows only: lifetime
+    appends exceed capacity several times over, so this passes only if
+    compaction reclaims cancelled pairs."""
+    N, E, churn = 48, 200, 0.2
+    churn_cap = bucket_capacity(2 * int(churn * E) + 2)
+    arena = (bucket_capacity(E) + 2 * churn_cap) * arena_mult
+    web = pagerank.WebGraph.random(N, E, seed=4)
+    pg = pagerank.build_graph(N, tol=1e-5, arena_capacity=arena)
+    ex = make_ex()
+    sched = DirtyScheduler(pg.graph, ex, max_loop_iters=500)
+    sched.push(pg.teleport, pagerank.teleport_batch(N))
+    sched.push(pg.edges, web.initial_batch())
+    assert sched.tick().quiesced
+    for i in range(ticks):
+        sched.push(pg.edges, web.churn(churn))
+        assert sched.tick().quiesced, f"tick {i}"
+    # GC genuinely required: the tracker's conservative per-shard lifetime
+    # charge (bucketed ingress capacities) dwarfs the per-shard capacity
+    assert bucket_capacity(E) + ticks * churn_cap > arena // arena_mult
+    ref = pagerank.reference_ranks(web)
+    ranks = sched.read_table(pg.new_rank)
+    err = max(abs(float(ranks.get(k, 1 - pagerank.DAMPING)) - ref[k])
+              for k in range(N))
+    assert err < 5e-3, err
+
+
+def _sharded():
+    from reflow_tpu.parallel import make_mesh
+    from reflow_tpu.parallel.shard import ShardedTpuExecutor
+
+    return ShardedTpuExecutor(make_mesh(8))

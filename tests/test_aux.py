@@ -155,3 +155,59 @@ def test_device_minmax_retraction_flags_error():
         sched.tick()
     with pytest.raises(RuntimeError, match="retraction"):
         sched.read_table(mx)
+
+
+def test_checkpoint_restores_arena_tracker(tmp_path):
+    """ADVICE r1 (medium): after restore, the TPU executor's host-side
+    join-arena overflow tracker must reflect the restored arena occupancy,
+    not bind()'s reset-to-zero — otherwise post-resume appends can exceed
+    arena_capacity and silently drop rows."""
+    ex = get_executor("tpu")
+    sched, pg, web = _pagerank_sched(ex)
+    used_before = dict(ex._arena_used)
+    assert any(v > 0 for v in used_before.values())
+    save_checkpoint(sched, str(tmp_path / "ck"))
+
+    ex2 = get_executor("tpu")
+    sched2 = DirtyScheduler(pg.graph, ex2, max_loop_iters=500)
+    assert all(v == 0 for v in ex2._arena_used.values())  # bind() reset
+    load_checkpoint(sched2, str(tmp_path / "ck"))
+    # reconstructed from the restored arenas' append counters: nonzero
+    # and never above the conservative pre-save bound
+    for nid, v in ex2._arena_used.items():
+        assert 0 < v <= used_before[nid]
+
+
+def test_device_rejects_oversized_weight_mass():
+    """ADVICE r1: a single batch whose |weight| mass reaches 2**24 would
+    be folded through an inexact float32 scatter — rejected at upload."""
+    from reflow_tpu.delta import Spec
+    from reflow_tpu.executors.device_delta import to_device
+
+    spec = Spec((), np.float32, key_space=8)
+    b = DeltaBatch(np.zeros(2, np.int64), np.ones(2, np.float32),
+                   np.array([1 << 23, 1 << 23], np.int64))
+    with pytest.raises(ValueError, match="weight mass"):
+        to_device(b, spec)
+
+
+def test_fixpoint_declines_loop_carried_arena():
+    """ADVICE r1: a Join whose right (arena) input is produced inside the
+    loop region appends rows every while_loop iteration, invisible to the
+    host overflow tracker — analyze() must send such graphs to the
+    host-driven loop, which tracks every pass."""
+    from reflow_tpu.executors.fixpoint import analyze
+    from reflow_tpu.executors.tpu import TpuExecutor
+
+    K = 8
+    uniq = Spec((), np.float32, key_space=K, unique=True)
+    raw = Spec((), np.float32, key_space=K)
+    g = FlowGraph("loop_arena")
+    x = g.loop("x", uniq)
+    left = g.source("left", uniq)
+    j = g.join(left, x, merge=lambda k, a, b: a * b, spec=raw,
+               arena_capacity=256, name="j")
+    nxt = g.reduce(j, "sum", tol=1e-3, name="nxt", spec=uniq)
+    g.close_loop(x, nxt)
+    g.validate()
+    assert analyze(g) is None

@@ -74,8 +74,11 @@ def test_sharded_pagerank_matches_single_device(mesh):
 
     a, b = ref_ranks["sharded"], ref_ranks["tpu"]
     assert set(a) == set(b)
+    # distinct accumulation orders (row-based sharded vs fused linear)
+    # give two tol-converged fixpoints within ~tol/(1-damping)
+    bound = 1e-5 / (1.0 - pagerank.DAMPING) + 1e-4
     for k in a:
-        assert abs(float(a[k]) - float(b[k])) < 1e-4
+        assert abs(float(a[k]) - float(b[k])) < bound
     # and both match the NumPy oracle on the churned graph
     arr = np.full(N, 1.0 - pagerank.DAMPING)
     for k, v in a.items():
