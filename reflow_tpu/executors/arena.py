@@ -41,10 +41,22 @@ def compact_arena(state: dict) -> dict:
     rk, rv, rw = state["rkeys"], state["rvals"], state["rw"]
     R = rk.shape[0]
     vcols = rv.reshape(R, -1)
-    # bitwise value identity: compare float payloads as int bit patterns
-    if jnp.issubdtype(vcols.dtype, jnp.floating):
+    # bitwise value identity at NATIVE width (ADVICE r2: narrowing 64-bit
+    # payloads to 32 bits before the compare can alias distinct values and
+    # corrupt non-matching rows): 64-bit dtypes bitcast to two int32
+    # columns, 32-bit to one, 16-bit through int16; sub-4-byte ints widen
+    # losslessly
+    itemsize = jnp.dtype(vcols.dtype).itemsize
+    if itemsize >= 4:
+        bits = jax.lax.bitcast_convert_type(vcols, jnp.int32).reshape(R, -1)
+    elif itemsize == 2:
         bits = jax.lax.bitcast_convert_type(
-            vcols.astype(jnp.float32), jnp.int32)
+            vcols, jnp.int16).astype(jnp.int32).reshape(R, -1)
+    elif jnp.issubdtype(vcols.dtype, jnp.floating):
+        # 1-byte floats (f8 variants): widen losslessly, then bitcast —
+        # a numeric int cast would truncate distinct values to one bucket
+        bits = jax.lax.bitcast_convert_type(
+            vcols.astype(jnp.float32), jnp.int32).reshape(R, -1)
     else:
         bits = vcols.astype(jnp.int32)
     live = rw != 0

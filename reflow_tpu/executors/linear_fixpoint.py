@@ -71,6 +71,21 @@ __all__ = ["LinearFixpointProgram", "LinearStructure", "analyze_linear"]
 _F32_EXACT = 1 << 24
 
 
+def _f32_roundtrip_safe(dtype) -> bool:
+    """Whether every value of ``dtype`` survives a cast through float32.
+
+    The budget tiers stack arena/loop values into f32 gather columns
+    (ADVICE r2: int32 >= 2**24, int64, and f64 payloads would silently
+    lose precision there and disagree with the dense tier).
+    """
+    dt = jnp.dtype(dtype)
+    if jnp.issubdtype(dt, jnp.floating):
+        return dt.itemsize <= 4   # f32 exact; bf16/f16 widen losslessly
+    if jnp.issubdtype(dt, jnp.integer) or dt == jnp.bool_:
+        return dt.itemsize <= 2   # int8/int16/uint* fit in f32's mantissa
+    return False
+
+
 @dataclasses.dataclass(frozen=True)
 class LinearStructure:
     """A loop region matching the fused delta-vector pattern."""
@@ -212,6 +227,15 @@ class LinearFixpointProgram:
                 or R.inputs[0].spec.key_space >= _F32_EXACT):
             raise ValueError("key space / arena too large for fused-f32 "
                              "index columns")
+        for what, dt in (("arena value", J.inputs[1].spec.value_dtype),
+                         ("join output value", J.spec.value_dtype),
+                         ("loop value", L.spec.value_dtype),
+                         ("reduce value", R.spec.value_dtype)):
+            if not _f32_roundtrip_safe(dt):
+                raise ValueError(
+                    f"{what} dtype {jnp.dtype(dt).name} does not round-trip "
+                    f"exactly through the fused loop's float32 columns; "
+                    f"using the row-based fixpoint")
 
         full_pass = executor.build_pass_fn(list(plan))
         exit_pass = (executor.build_pass_fn(list(structure.exit_plan))

@@ -257,11 +257,19 @@ class TpuExecutor(Executor):
         for nid, st in self.states.items():
             if isinstance(st, dict) and "error" in st and bool(st["error"]):
                 node = self.graph.nodes[nid]
-                raise RuntimeError(
-                    f"{node}: a retraction reached a device min/max "
-                    f"reducer (insert-only on device); this tick's state "
-                    f"is invalid — run retraction-bearing min/max on the "
-                    f"CPU executor")
+                raise RuntimeError(f"{node}: {self._error_reason(node)}")
+
+    @staticmethod
+    def _error_reason(node: Node) -> str:
+        if (node.kind == "op" and node.op.kind == "reduce"
+                and node.op.how in ("min", "max")):
+            return ("a retraction reached a device min/max reducer "
+                    "(insert-only on device); this tick's state is invalid "
+                    "— run retraction-bearing min/max on the CPU executor")
+        return ("sticky device error flag set (sparse-route overflow: key "
+                "skew exceeded the ROUTE_SLACK per-destination budget); "
+                "this tick's state is invalid — raise the delta capacity "
+                "or rebalance the key space")
 
     def read_table(self, node: Node):
         import numpy as np
@@ -271,10 +279,7 @@ class TpuExecutor(Executor):
             raise KeyError(f"{node} holds no materialized state")
         if node.op.kind == "reduce":
             if "error" in st and bool(st["error"]):
-                raise RuntimeError(
-                    f"{node}: a retraction reached a device min/max "
-                    f"reducer (insert-only on device) — this table is "
-                    f"invalid; rerun on the CPU executor")
+                raise RuntimeError(f"{node}: {self._error_reason(node)}")
             has = np.asarray(st["emitted_has"])
             vals = np.asarray(st["emitted"])
             keys = np.nonzero(has)[0]

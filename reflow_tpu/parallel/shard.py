@@ -71,6 +71,10 @@ class ShardedTpuExecutor(TpuExecutor):
                         f"{node}: {node.op.how} has no sharded lowering "
                         f"yet; use the single-device TpuExecutor or the "
                         f"CPU oracle")
+                # sparse-route overflow is surfaced through the same sticky
+                # per-node error scalar min/max use (ADVICE r2 high: without
+                # this key the route_rows overflow flag would be dropped)
+                self.states[node.id]["error"] = jnp.zeros((), jnp.bool_)
             if node.op.kind == "join":
                 if node.op.arena_capacity % n:
                     raise GraphError(

@@ -117,7 +117,9 @@ def _lower_reduce_sharded(op, node: Node, state, ins, axis: str, n: int
     vdtype = node.spec.value_dtype
     base = (jax.lax.axis_index(axis) * Kl).astype(jnp.int32)
     vshape = d.values.shape[1:]
-    err = state.get("error")
+    # linear reducers get their error scalar at sharded bind time, so the
+    # route-overflow flag below is never silently dropped (ADVICE r2 high)
+    err = state.get("error", jnp.zeros((), jnp.bool_))
 
     if ROUTE_SLACK * Cl < Kl:
         # sparse regime: route rows to their key's owner and fold locally
@@ -126,8 +128,7 @@ def _lower_reduce_sharded(op, node: Node, state, ins, axis: str, n: int
         dws, dwc = _scatter_contribs(dl, Kl)
         wsum = state["wsum"] + dws
         wcnt = state["wcnt"] + dwc
-        if err is not None:
-            err = err | (jax.lax.pmax(route_err.astype(jnp.int32), axis) > 0)
+        err = err | (jax.lax.pmax(route_err.astype(jnp.int32), axis) > 0)
     else:
         # dense regime (most keys touched, e.g. rebuild passes): full-K
         # local contributions + one reduce-scatter
@@ -156,9 +157,8 @@ def _lower_reduce_sharded(op, node: Node, state, ins, axis: str, n: int
     new_emitted = jnp.where(ins_b, agg, emitted)
     new_has = jnp.where(ins_m, True, jnp.where(ret_m & ~exists, False, em_has))
     new_state = {"wsum": wsum, "wcnt": wcnt,
-                 "emitted": new_emitted, "emitted_has": new_has}
-    if err is not None:
-        new_state["error"] = err
+                 "emitted": new_emitted, "emitted_has": new_has,
+                 "error": err}
     return out, new_state
 
 

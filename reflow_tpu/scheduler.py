@@ -20,7 +20,7 @@ from __future__ import annotations
 import dataclasses
 import time
 from collections import Counter, defaultdict
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 from reflow_tpu.delta import DeltaBatch
 from reflow_tpu.executors import CpuExecutor, Executor
@@ -46,6 +46,12 @@ class TickResult:
     deltas_out: int
     wall_s: float
     quiesced: bool
+    #: captured executor error check for streaming ticks whose per-tick
+    #: check was deferred; ``block()`` (the documented streaming sync
+    #: point) runs it so sticky flags can't finish a run unsurfaced
+    #: (ADVICE r2: a pure-streaming run never otherwise checked)
+    _check_errors: Optional[Callable[[], None]] = dataclasses.field(
+        default=None, repr=False, compare=False)
 
     @property
     def delta_ops(self) -> int:
@@ -53,12 +59,16 @@ class TickResult:
         return self.deltas_in + self.deltas_out
 
     def block(self) -> "TickResult":
-        """Force any device-resident scalar fields to host values (the
-        streaming sync point; a no-op for synchronous ticks)."""
+        """Force any device-resident scalar fields to host values and
+        surface deferred executor errors (the streaming sync point; a
+        no-op for synchronous ticks)."""
         self.passes = int(self.passes)
         self.deltas_in = int(self.deltas_in)
         self.deltas_out = int(self.deltas_out)
         self.quiesced = bool(self.quiesced)
+        if self._check_errors is not None:
+            check, self._check_errors = self._check_errors, None
+            check()
         return self
 
 
@@ -180,7 +190,8 @@ class DirtyScheduler:
         # ticks (sync=False) defer the check to the next sync point —
         # unless sink views are about to be materialized, which forces a
         # sync anyway and must not fold corrupt deltas
-        if sync or sink_deltas:
+        checked = sync or bool(sink_deltas)
+        if checked:
             self.executor.check_errors()
 
         out: Dict[str, DeltaBatch] = {}
@@ -207,6 +218,7 @@ class DirtyScheduler:
             deltas_out=deltas_out,
             wall_s=time.perf_counter() - t0,
             quiesced=quiesced,
+            _check_errors=None if checked else self.executor.check_errors,
         )
         self.history.append(result)
         return result

@@ -76,3 +76,34 @@ def _sharded():
     from reflow_tpu.parallel.shard import ShardedTpuExecutor
 
     return ShardedTpuExecutor(make_mesh(8))
+
+
+def test_compact_arena_native_width_bit_identity():
+    """ADVICE r2: distinct 64-bit values that alias as float32/int32 must
+    NOT be grouped — the bit compare runs at native width."""
+    import jax
+    import jax.numpy as jnp
+
+    from reflow_tpu.executors.arena import compact_arena
+
+    jax.config.update("jax_enable_x64", True)
+    try:
+        R = 8
+        a, b = 1.0, 1.0 + 2.0**-40        # equal after a float32 cast
+        rk = jnp.zeros(R, jnp.int32).at[:2].set(
+            jnp.array([5, 5], jnp.int32))
+        rv = jnp.zeros((R, 1), jnp.float64).at[:2, 0].set(
+            jnp.array([a, b], jnp.float64))
+        rw = jnp.zeros(R, jnp.int32).at[:2].set(
+            jnp.array([1, -1], jnp.int32))
+        state = {"lval": jnp.zeros((4,)), "lw": jnp.zeros((4,), jnp.int32),
+                 "rkeys": rk, "rvals": rv, "rw": rw,
+                 "rcount": jnp.asarray(2, jnp.int32)}
+        out = compact_arena(state)
+        # the pair must survive (values differ bitwise), not cancel
+        assert int(out["rcount"]) == 2
+        live = np.asarray(out["rw"]) != 0
+        vals = sorted(np.asarray(out["rvals"])[live, 0].tolist())
+        assert vals == [a, b]
+    finally:
+        jax.config.update("jax_enable_x64", False)

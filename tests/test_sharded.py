@@ -134,3 +134,18 @@ def test_key_space_divisibility_enforced(mesh):
 
     with pytest.raises(GraphError, match="multiple of the mesh"):
         DirtyScheduler(g, ShardedTpuExecutor(mesh))
+
+
+def test_sharded_route_overflow_surfaces(mesh):
+    """ADVICE r2 (high): pathological key skew past the ROUTE_SLACK budget
+    must raise through check_errors for LINEAR reducers too — never a
+    silently wrong aggregate."""
+    K = 512  # Kl=64 per shard; delta cap 64 -> Cl=8 -> sparse regime
+    g, src, _ = _reduce_graph(K)
+    sh = DirtyScheduler(g, ShardedTpuExecutor(mesh))
+    n = 64
+    keys = np.arange(n) % 64  # every key owned by shard 0: worst-case skew
+    sh.push(src, DeltaBatch(keys, np.ones(n, np.float32),
+                            np.ones(n, np.int64)))
+    with pytest.raises(RuntimeError, match="route overflow"):
+        sh.tick()

@@ -254,3 +254,19 @@ def test_tpu_reduce_tol_quiesces():
     sched.push(src, int_batch([(1, 1e-6, 1)]))
     r2 = sched.tick()
     assert r2.sink_deltas == {} or len(r2.sink_deltas.get("out", [])) == 0
+
+
+def test_streaming_deferred_error_surfaces_at_block():
+    """ADVICE r2: a sinkless streaming run must surface sticky error flags
+    at ``block()`` (the documented streaming sync point), not never."""
+    g = FlowGraph()
+    src = g.source("in", Spec((), np.float32, key_space=8))
+    g.reduce(src, "min", name="lo")  # no sink: streaming defers the check
+    sched = DirtyScheduler(g, get_executor("tpu"))
+    sched.push(src, DeltaBatch(np.array([1]), np.array([3.0], np.float32)))
+    sched.tick(sync=False).block()  # insert only: clean
+    sched.push(src, DeltaBatch(np.array([1]), np.array([3.0], np.float32),
+                               np.array([-1])))
+    res = sched.tick(sync=False)    # retraction -> sticky flag, deferred
+    with pytest.raises(RuntimeError, match="min/max"):
+        res.block()
