@@ -22,6 +22,36 @@ def _record(log, name: str, rec: dict) -> None:
     log(json.dumps(rec))
 
 
+def _timed_tick(sched, **kw):
+    """One tick measured to DEVICE COMPLETION (VERDICT r2 weak #4: sinkless
+    graphs return after dispatch, so ``r.wall_s`` alone can record an
+    enqueue time — 2.3ms for a 400-GFLOP rescan). Blocks on every executor
+    state leaf before reading the clock."""
+    import jax
+
+    t0 = time.perf_counter()
+    r = sched.tick(**kw)
+    states = getattr(sched.executor, "states", None)
+    if states:
+        jax.block_until_ready(states)
+    return time.perf_counter() - t0, r
+
+
+def _pad_batch(batch, rows: int):
+    """Pad a host DeltaBatch to a fixed row count with weight-0 rows so
+    every edit tick hits ONE capacity bucket (VERDICT r2 weak #5: batches
+    wandering across buckets kept recompiling in steady state)."""
+    from reflow_tpu.delta import DeltaBatch
+
+    n = len(batch)
+    if n >= rows:
+        return batch
+    pad = rows - n
+    vals = np.zeros((pad,) + batch.values.shape[1:], batch.values.dtype)
+    return DeltaBatch.concat([batch, DeltaBatch(
+        np.zeros(pad, np.int64), vals, np.zeros(pad, np.int64))])
+
+
 def _guard(log, name: str):
     def deco(fn):
         def wrapped(*a, **k):
@@ -86,10 +116,13 @@ def cfg2_tfidf(smoke: bool, log) -> None:
     from reflow_tpu.workloads import tfidf
 
     n_docs = 64 if smoke else 4_096
-    n_terms = 1 << (10 if smoke else 14)
+    # 2^20-term vocabulary (a real Wikipedia-scale vocab is ~10^6; the
+    # radix-split presence path is exact to 2^24 — workloads/tfidf.py)
+    n_terms = 1 << (10 if smoke else 20)
     n_pairs = 1 << (12 if smoke else 18)
     edits = 32 if smoke else 512
-    words = [f"t{i}" for i in range(n_terms - 64)]
+    vocab = 1_000 if smoke else 250_000  # drawn words (ids intern densely)
+    words = [f"t{i}" for i in range(vocab)]
 
     for ex_name in ("cpu", "tpu"):
         @_guard(log, f"2_tfidf_{ex_name}")
@@ -107,16 +140,28 @@ def cfg2_tfidf(smoke: bool, log) -> None:
             from reflow_tpu.delta import DeltaBatch
             sched.push(tg.tokens, DeltaBatch.concat(batches))
             sched.tick()
-            # warm the churn shape
-            sched.push(tg.tokens, corpus.edit(0, text()))
-            sched.tick()
+            # device path: every edit batch is padded to ONE fixed
+            # capacity bucket so steady state compiles exactly one churn
+            # program. The CPU oracle pays per-row cost for pad rows, so
+            # it gets the raw batches; pad rows are excluded from BOTH
+            # executors' delta-ops numerators (they are no-ops)
+            edit_rows = 256 if ex_name != "cpu" else 0
+
+            def _push_edit(batch):
+                pad = max(0, edit_rows - len(batch))
+                sched.push(tg.tokens, _pad_batch(batch, edit_rows)
+                           if edit_rows else batch)
+                return pad
+
+            _push_edit(corpus.edit(0, text()))  # warm the churn shape
+            _timed_tick(sched)
             walls, dops = [], []
             for i in range(edits):
                 d = int(rng.integers(0, n_docs))
-                sched.push(tg.tokens, corpus.edit(d, text()))
-                r = sched.tick()
-                walls.append(r.wall_s)
-                dops.append(r.delta_ops)
+                pad = _push_edit(corpus.edit(d, text()))
+                wall, r = _timed_tick(sched)
+                walls.append(wall)
+                dops.append(r.delta_ops - pad)
             _record(log, f"2_tfidf_{ex_name}", {
                 "executor": ex_name,
                 "docs": n_docs, "terms": n_terms,
@@ -174,21 +219,20 @@ def cfg4_knn(smoke: bool, log) -> None:
             sched.tick()
         preload_s = time.perf_counter() - t0
         sched.push(kg.docs, insert(per_tick))
-        sched.tick()
+        _timed_tick(sched)
         sched.push(kg.docs, store.retract_batch(np.arange(per_tick // 8)))
-        sched.tick()
+        _timed_tick(sched)
 
         walls, dops = [], []
         for _ in range(6):   # insert-heavy re-index flow
             sched.push(kg.docs, insert(per_tick))
-            r = sched.tick()
-            walls.append(r.wall_s)
+            wall, r = _timed_tick(sched)
+            walls.append(wall)
             dops.append(r.delta_ops)
         # one retraction tick: triggers the chunked full-corpus rescan
         retract_ids = np.arange(per_tick // 8, per_tick // 4)
         sched.push(kg.docs, store.retract_batch(retract_ids))
-        r = sched.tick()
-        rescan_wall = r.wall_s
+        rescan_wall, r = _timed_tick(sched)
 
         _record(log, "4_knn", {
             "executor": "tpu",
@@ -237,16 +281,16 @@ def cfg5_image_embed(smoke: bool, log) -> None:
             return stream.insert(ids, groups)
 
         sched.push(ig.images, insert(per_tick))
-        sched.tick()                       # compile absorption
+        _timed_tick(sched)                 # compile absorption
         walls, dops = [], []
         for _ in range(ticks):
             sched.push(ig.images, insert(per_tick))
-            r = sched.tick()
-            walls.append(r.wall_s)
+            wall, r = _timed_tick(sched)
+            walls.append(wall)
             dops.append(r.delta_ops)
         # a group move: retract/insert pair through the model
         sched.push(ig.images, stream.move(0, 1))
-        r = sched.tick()
+        move_wall, r = _timed_tick(sched)
 
         _record(log, "5_image_embed", {
             "executor": "sharded",
@@ -255,6 +299,6 @@ def cfg5_image_embed(smoke: bool, log) -> None:
             "images_per_tick": per_tick,
             "delta_ops_per_s": round(sum(dops) / sum(walls), 1),
             "images_per_s": round(per_tick * ticks / sum(walls), 2),
-            "move_tick_ms": round(1e3 * r.wall_s, 1),
+            "move_tick_ms": round(1e3 * move_wall, 1),
         })
     run()

@@ -34,6 +34,17 @@ def _lazy_sharded():
     return ShardedTpuExecutor
 
 
+def _lazy_staged():
+    try:
+        from reflow_tpu.parallel.topo import StagedTpuExecutor  # noqa: F401
+    except ImportError as e:
+        raise NotImplementedError(
+            "the 'staged' executor requires jax "
+            f"(import failed: {e})") from e
+    return StagedTpuExecutor
+
+
 register_executor("cpu", CpuExecutor)
 register_executor("tpu", _lazy_tpu)
 register_executor("sharded", _lazy_sharded)
+register_executor("staged", _lazy_staged)

@@ -265,7 +265,9 @@ class FixpointProgram:
                     sink_egress[sid] = tuple(batches)
             return states, sink_egress, iters, rows, converged
 
-        self._fn = jax.jit(tick_fn)
+        # donate the state pytree: ticks update arenas/tables in place
+        # instead of copying them (the executor drops old refs on return)
+        self._fn = jax.jit(tick_fn, donate_argnums=0)
 
     def __call__(self, op_states, dev_ingress):
         """-> (states', {sink_id: (DeviceDelta, ...)}, iters, loop_rows,

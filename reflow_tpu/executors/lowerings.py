@@ -125,6 +125,17 @@ def _apply_rowfn(fn, vectorized: bool, *cols):
 
 def _lower_map(op: Map, node: Node, state, ins) -> Tuple[DeviceDelta, None]:
     (d,) = ins
+    if op.params is not None:
+        # params flow in as op STATE (a program argument), never as traced
+        # constants — program size stays independent of the model size and
+        # params swap without recompiling. State passes through unchanged.
+        p = state["params"]
+        if op.vectorized:
+            vals = op.fn(p, d.values)
+        else:
+            vals = jax.vmap(op.fn, in_axes=(None, 0))(p, d.values)
+        return (DeviceDelta(d.keys, jnp.asarray(vals, node.spec.value_dtype),
+                            d.weights), state)
     vals = _apply_rowfn(op.fn, op.vectorized, d.values)
     vals = jnp.asarray(vals, node.spec.value_dtype)
     return DeviceDelta(d.keys, vals, d.weights), None

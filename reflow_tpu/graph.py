@@ -139,8 +139,9 @@ class FlowGraph:
 
     def map(self, input: Node, fn: Callable, *, vectorized: bool = False,
             linear: bool = False, name: Optional[str] = None,
-            spec: Optional[Spec] = None) -> Node:
-        op = Map(fn, vectorized=vectorized, linear=linear, out_spec=spec)
+            spec: Optional[Spec] = None, params=None) -> Node:
+        op = Map(fn, vectorized=vectorized, linear=linear, out_spec=spec,
+                 params=params)
         return self.add_op(op, [input], name=name)
 
     def filter(self, input: Node, pred: Callable, *, vectorized: bool = False,

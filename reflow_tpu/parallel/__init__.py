@@ -7,9 +7,13 @@ The parallelism strategies native to this framework class:
   changes.
 - **key-parallel (tp analog)**: keyed state tables (Reduce aggregates, Join
   left tables) sharded along the key axis — each chip owns a key range;
-  cross-shard combination is ``psum``/``all_to_all`` key routing.
-- **topo-partitioning (pp analog)**: FlowGraph stages placed on mesh
-  sub-axes (Node.stage).
+  cross-shard combination is ``psum_scatter`` (dense) or ``all_to_all``
+  key routing (sparse Reduce; large-delta Join sides — see
+  ``shard_lowerings.route_rows``).
+- **topo-partitioning (pp analog)**: contiguous FlowGraph stages pinned to
+  separate devices with per-stage pass programs and explicit
+  ``device_put`` boundary handoff — ``topo.StagedTpuExecutor``, driven by
+  ``Node.stage``.
 
 This package provides the mesh construction + NamedSharding placement
 helpers shared by the sharded executor, ``__graft_entry__.dryrun_multichip``
@@ -19,4 +23,17 @@ and the benchmark harness.
 from reflow_tpu.parallel.mesh import (DELTA_AXIS, make_mesh, replicate,
                                       shard_state_tree)
 
-__all__ = ["DELTA_AXIS", "make_mesh", "replicate", "shard_state_tree"]
+__all__ = ["DELTA_AXIS", "make_mesh", "replicate", "shard_state_tree",
+           "StagedTpuExecutor", "ShardedTpuExecutor"]
+
+
+def __getattr__(name):
+    # lazy: keep `import reflow_tpu.parallel` jax-free until an executor
+    # class is actually requested
+    if name == "StagedTpuExecutor":
+        from reflow_tpu.parallel.topo import StagedTpuExecutor
+        return StagedTpuExecutor
+    if name == "ShardedTpuExecutor":
+        from reflow_tpu.parallel.shard import ShardedTpuExecutor
+        return ShardedTpuExecutor
+    raise AttributeError(name)

@@ -2,11 +2,14 @@
 
 SURVEY.md §7.8 / north star: delta buffers row-sharded over the mesh, keyed
 state tables key-range-sharded, cross-shard combines as explicit
-collectives (``psum_scatter`` in Reduce, ``all_gather`` key-routing in
-Join) under ``jax.shard_map``. Composes with the on-device fixpoint
+collectives (``psum_scatter``/``all_to_all`` row routing in Reduce and
+Join, ``pmax`` extrema combine in min/max, ``all_gather`` candidate merge
+in k-NN) under ``jax.shard_map``. Composes with the on-device fixpoint
 unchanged: ``build_pass_fn`` keeps the global ``(states, ingress) ->
 (states', egress)`` signature, so ``FixpointProgram`` wraps the shard_map'd
-pass in its ``lax.while_loop`` exactly like the single-device one.
+pass in its ``lax.while_loop`` exactly like the single-device one — and
+the fused linear fixpoint runs its whole loop inside one shard_map region
+(linear_fixpoint.py).
 
 Divisibility contract (validated at bind): the mesh size must be a power
 of two no larger than the minimum delta capacity (so every bucketed delta
@@ -25,7 +28,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from reflow_tpu.executors.device_delta import MIN_CAPACITY, DeviceDelta
 from reflow_tpu.executors.tpu import TpuExecutor
 from reflow_tpu.graph import FlowGraph, GraphError, Node
-from reflow_tpu.parallel.mesh import make_mesh, shard_state_tree
+from reflow_tpu.parallel.mesh import make_mesh, replicate, shard_state_tree
 from reflow_tpu.parallel.shard_lowerings import lower_node_sharded
 
 __all__ = ["ShardedTpuExecutor"]
@@ -50,11 +53,28 @@ class ShardedTpuExecutor(TpuExecutor):
     def bind(self, graph: FlowGraph) -> None:
         super().bind(graph)
         n = self.n
+        #: node ids whose state is mesh-REPLICATED (Map params: every
+        #: shard runs the full model on its delta slice — data parallel),
+        #: vs the default key/row sharding of table/arena states
+        self._replicated_ids = {
+            node.id for node in graph.nodes
+            if node.kind == "op" and node.op.kind == "map"
+            and node.op.params is not None}
+        self._knn_ids = set()
         for node in graph.nodes:
             if node.kind == "op" and node.op.kind == "knn":
-                raise GraphError(
-                    f"{node}: knn has no sharded lowering yet; run it on "
-                    f"the single-device TpuExecutor")
+                Q = node.inputs[0].spec.key_space
+                D = node.inputs[1].spec.key_space
+                if Q % n or D % n:
+                    raise GraphError(
+                        f"{node}: query space {Q} and corpus space {D} "
+                        f"must be multiples of the mesh size {n}")
+                if (D // n) % min(node.op.scan_chunk, D // n):
+                    raise GraphError(
+                        f"{node}: per-shard corpus {D // n} must be a "
+                        f"multiple of scan_chunk {node.op.scan_chunk}")
+                self._knn_ids.add(node.id)
+                continue
             if node.kind != "op" or node.op.kind not in ("reduce", "join"):
                 continue
             K = node.inputs[0].spec.key_space
@@ -66,29 +86,75 @@ class ShardedTpuExecutor(TpuExecutor):
                 from reflow_tpu.executors.lowerings import \
                     LINEAR_DEVICE_REDUCERS
 
-                if node.op.how not in LINEAR_DEVICE_REDUCERS:
-                    raise GraphError(
-                        f"{node}: {node.op.how} has no sharded lowering "
-                        f"yet; use the single-device TpuExecutor or the "
-                        f"CPU oracle")
-                # sparse-route overflow is surfaced through the same sticky
-                # per-node error scalar min/max use (ADVICE r2 high: without
-                # this key the route_rows overflow flag would be dropped)
-                self.states[node.id]["error"] = jnp.zeros((), jnp.bool_)
+                if node.op.how in LINEAR_DEVICE_REDUCERS:
+                    # sparse-route overflow is surfaced through the same
+                    # sticky per-node error scalar min/max use (ADVICE r2
+                    # high: without this key the route_rows overflow flag
+                    # would be dropped)
+                    self.states[node.id]["error"] = jnp.zeros((), jnp.bool_)
+                # min/max states (agg/wcnt/emitted tables) key-shard like
+                # the linear ones; their error scalar ships in reduce_state
             if node.op.kind == "join":
                 if node.op.arena_capacity % n:
                     raise GraphError(
                         f"{node}: arena_capacity {node.op.arena_capacity} "
                         f"must be a multiple of the mesh size {n}")
-                # per-shard append counters (one scalar per mesh slot)
+                # per-shard append counters (one scalar per mesh slot) +
+                # the sticky route-overflow flag (large meshes route both
+                # delta sides to key owners via all_to_all)
                 self.states[node.id]["rcount"] = jnp.zeros((n,), jnp.int32)
-        self.states = shard_state_tree(self.states, self.mesh,
-                                       axis_name=self.axis)
+                self.states[node.id]["error"] = jnp.zeros((), jnp.bool_)
+        from jax.sharding import NamedSharding
+        from reflow_tpu.parallel.shard_lowerings import knn_state_specs
+
+        knn_axes = knn_state_specs(self.axis)
+
+        def _place(nid, st):
+            if nid in self._replicated_ids:
+                return replicate(st, self.mesh)
+            if nid in self._knn_ids:
+                # per-leaf: corpus sharded, queries/emission replicated —
+                # the dim-0 heuristic would wrongly shard a query table
+                # whose capacity happens to divide the mesh
+                return {k: jax.device_put(v, NamedSharding(
+                            self.mesh, P(knn_axes[k])
+                            if knn_axes[k] else P()))
+                        for k, v in st.items()}
+            return shard_state_tree(st, self.mesh, axis_name=self.axis)
+
+        self.states = {nid: _place(nid, st)
+                       for nid, st in self.states.items()}
+        self.warm_gc()
 
     def _state_spec(self, x) -> P:
         if getattr(x, "ndim", 0) >= 1 and x.shape[0] % self.n == 0:
             return P(self.axis)
         return P()
+
+    def _state_tree_specs(self, states):
+        """Per-node shard_map partition specs: replicated nodes (Map
+        params) get P() on every leaf regardless of divisibility — a
+        weight matrix whose dim 0 happens to divide the mesh must NOT be
+        row-sharded — and knn states use their per-leaf layout."""
+        from reflow_tpu.parallel.shard_lowerings import knn_state_specs
+
+        repl = getattr(self, "_replicated_ids", frozenset())
+        knn_ids = getattr(self, "_knn_ids", frozenset())
+        knn_axes = knn_state_specs(self.axis)
+
+        def specs(nid, st):
+            if nid in repl:
+                return jax.tree.map(lambda _: P(), st)
+            if nid in knn_ids:
+                return {k: P(knn_axes[k]) if knn_axes[k] else P()
+                        for k in st}
+            return jax.tree.map(self._state_spec, st)
+
+        return {nid: specs(nid, st) for nid, st in states.items()}
+
+    def update_params(self, node: Node, params) -> None:
+        super().update_params(node, params)
+        self.states[node.id] = replicate(self.states[node.id], self.mesh)
 
     def _gc_fn(self):
         """Per-shard arena compaction under shard_map: rows never migrate
@@ -114,15 +180,16 @@ class ShardedTpuExecutor(TpuExecutor):
     def _lower(self, node: Node, state, ins):
         return lower_node_sharded(node, state, ins, self.axis, self.n)
 
-    def build_pass_fn(self, plan: List[Node]):
+    def build_pass_fn(self, plan: List[Node], extra_egress=()):
         graph = self.graph
         mesh, axis = self.mesh, self.axis
         # the shared traversal from TpuExecutor (with this class's _lower
         # hook) becomes the per-shard body under shard_map
-        local_pass = super().build_pass_fn(plan)
+        local_pass = super().build_pass_fn(plan, extra_egress)
         sink_inputs = [(s.inputs[0].id, s.id) for s in graph.sinks]
         back_edges = [(l.back_input.id, l.id) for l in graph.loops
                       if l.back_input is not None]
+        extra = tuple(extra_egress)
         dspec = DeviceDelta(P(axis), P(axis), P(axis))
 
         def _egress_ids(ingress_ids):
@@ -136,12 +203,13 @@ class ShardedTpuExecutor(TpuExecutor):
                     outs.add(node.id)
             eg = [sid for src, sid in sink_inputs if src in outs]
             eg += [lid for bid, lid in back_edges if bid in outs]
+            eg += [nid for nid in extra if nid in outs]
             return eg
 
         def pass_fn(states, ingress):
             # ingress structure is static at trace time: derive the
             # shard_map partitioning specs for exactly this signature
-            state_specs = jax.tree.map(self._state_spec, states)
+            state_specs = self._state_tree_specs(states)
             in_specs = (state_specs, {nid: dspec for nid in ingress})
             out_specs = (state_specs, {eid: dspec
                                        for eid in _egress_ids(ingress)})

@@ -24,8 +24,11 @@ what's big):
 - **Join**: both delta sides are routed to key owners (``all_to_all``)
   and fed to the shared :func:`join_core` over the shard's slice of the
   left table and append arena; meshes too small for routing to win
-  (n <= ROUTE_SLACK) keep the tiled ``all_gather`` + mask. Output rows
-  stay on the owning shard (row-sharded), keys global.
+  (n <= ROUTE_SLACK) and deltas whose per-destination budget would fall
+  under ``_MIN_ROUTE_BUDGET`` rows keep the tiled ``all_gather`` + mask.
+  Output rows stay on the owning shard (row-sharded), keys global. Arena
+  rows therefore always carry shard-LOCAL keys — the invariant the
+  sharded linear fixpoint's per-shard CSR relies on.
 
 Keyed state is range-sharded: shard ``i`` of ``n`` owns keys
 ``[i*K/n, (i+1)*K/n)``. Range (not hash) sharding keeps key<->shard
@@ -40,8 +43,8 @@ import jax
 import jax.numpy as jnp
 
 from reflow_tpu.executors.device_delta import DeviceDelta
-from reflow_tpu.executors.lowerings import (_LOWERINGS, _agg_tables,
-                                            _bcast_w, _differs,
+from reflow_tpu.executors.lowerings import (_LOWERINGS, LINEAR_DEVICE_REDUCERS,
+                                            _agg_tables, _bcast_w, _differs,
                                             _scatter_contribs, join_core)
 from reflow_tpu.graph import Node
 
@@ -51,6 +54,10 @@ __all__ = ["lower_node_sharded", "route_rows", "ROUTE_SLACK"]
 #: share. 4x absorbs realistic key skew; pathological skew trips the
 #: sticky overflow flag instead of truncating.
 ROUTE_SLACK = 4
+#: the Join routes a delta side only when its per-destination budget is at
+#: least this many rows — thin budgets trip on ordinary randomness, and
+#: replicating a small delta costs next to nothing
+_MIN_ROUTE_BUDGET = 64
 
 
 def route_rows(d: DeviceDelta, axis: str, n: int, Kl: int,
@@ -162,6 +169,83 @@ def _lower_reduce_sharded(op, node: Node, state, ins, axis: str, n: int
     return out, new_state
 
 
+def _lower_reduce_minmax_sharded(op, node: Node, state, ins, axis: str,
+                                 n: int) -> Tuple[DeviceDelta, dict]:
+    """Insert-only scatter-extrema, key-sharded: each shard builds a dense
+    GLOBAL candidate table from its delta slice, one ``pmax``/``pmin``
+    all-reduce combines them, and the owned slice folds into local state.
+    Retractions set the sticky error flag exactly like the single-device
+    path (SURVEY.md §7 hard part c)."""
+    (d,) = ins
+    K = node.inputs[0].spec.key_space
+    Kl = K // n
+    Cl = d.keys.shape[0]
+    vdtype = node.spec.value_dtype
+    pad = jnp.inf if op.how == "min" else -jnp.inf
+    base = (jax.lax.axis_index(axis) * Kl).astype(jnp.int32)
+    vshape = d.values.shape[1:]
+
+    # retraction check runs on the pre-route rows (routing may budget-drop)
+    retract = jnp.any(d.weights < 0)
+    error = state["error"] | (jax.lax.pmax(retract.astype(jnp.int32),
+                                           axis) > 0)
+
+    if ROUTE_SLACK * Cl < Kl:
+        # sparse regime: route rows to their owner, take extrema locally —
+        # comms O(slack*Cl), never a dense global-K table
+        dl, route_err = route_rows(d, axis, n, Kl)
+        error = error | (jax.lax.pmax(route_err.astype(jnp.int32),
+                                      axis) > 0)
+        live_keys = jnp.where(dl.weights > 0, dl.keys, Kl)
+        vals = jnp.where(_bcast_w(dl.weights > 0, dl.values),
+                         dl.values.astype(jnp.float32), pad)
+        if op.how == "min":
+            agg = state["agg"].at[live_keys].min(vals, mode="drop")
+        else:
+            agg = state["agg"].at[live_keys].max(vals, mode="drop")
+        # routed keys are already local in [0, Kl); padding rows carry
+        # key 0 / weight 0 and vanish in the add
+        wcnt = state["wcnt"].at[dl.keys].add(dl.weights)
+    else:
+        # dense regime: global-K candidate table + one extrema all-reduce
+        live_keys = jnp.where(d.weights > 0, d.keys, K)
+        vals = jnp.where(_bcast_w(d.weights > 0, d.values),
+                         d.values.astype(jnp.float32), pad)
+        cand = jnp.full((K,) + vshape, pad, jnp.float32)
+        if op.how == "min":
+            cand = cand.at[live_keys].min(vals, mode="drop")
+            cand = -jax.lax.pmax(-cand, axis)
+        else:
+            cand = cand.at[live_keys].max(vals, mode="drop")
+            cand = jax.lax.pmax(cand, axis)
+        own = jax.lax.dynamic_slice_in_dim(cand, base, Kl, 0)
+        agg = (jnp.minimum(state["agg"], own) if op.how == "min"
+               else jnp.maximum(state["agg"], own))
+        dwc = jnp.zeros((K,), jnp.float32).at[d.keys].add(
+            d.weights.astype(jnp.float32))
+        dwc = jax.lax.psum_scatter(dwc, axis, scatter_dimension=0,
+                                   tiled=True)
+        wcnt = state["wcnt"] + dwc.astype(jnp.int32)
+
+    emitted, em_has = state["emitted"], state["emitted_has"]
+    exists = wcnt > 0
+    aggv = jnp.asarray(agg, vdtype)
+    changed = _differs(aggv, emitted, op.tol)
+    ins_m = exists & (~em_has | changed)
+    ret_m = em_has & (~exists | changed)
+    gkeys = base + jnp.arange(Kl, dtype=jnp.int32)
+    out = DeviceDelta(
+        keys=jnp.concatenate([gkeys, gkeys]),
+        values=jnp.concatenate([emitted, aggv]),
+        weights=jnp.concatenate(
+            [-ret_m.astype(jnp.int32), ins_m.astype(jnp.int32)]),
+    )
+    new_emitted = jnp.where(_bcast_w(ins_m, aggv), aggv, emitted)
+    new_has = jnp.where(ins_m, True, jnp.where(ret_m & ~exists, False, em_has))
+    return out, {"agg": agg, "wcnt": wcnt, "emitted": new_emitted,
+                 "emitted_has": new_has, "error": error}
+
+
 def _lower_join_sharded(op, node: Node, state, ins, axis: str, n: int
                         ) -> Tuple[DeviceDelta, dict]:
     da, db = ins                    # local delta rows
@@ -169,11 +253,23 @@ def _lower_join_sharded(op, node: Node, state, ins, axis: str, n: int
     Kl = K // n
     Rl = op.arena_capacity // n
     base = (jax.lax.axis_index(axis) * Kl).astype(jnp.int32)
+    err = state.get("error", jnp.zeros((), jnp.bool_))
 
-    # deltas are small: gather both sides everywhere, keep only owned rows
+    # both delta sides reach their key's owner: routed (one all_to_all,
+    # O(slack x rows) traffic) on meshes where routing beats replication;
+    # small meshes (n <= ROUTE_SLACK) and small deltas (per-destination
+    # budget under _MIN_ROUTE_BUDGET rows — skew trips a thin budget far
+    # too easily, and tiny batches are cheap to replicate) keep the tiled
+    # all_gather + mask, whose O(n x rows) traffic is then no worse
     def _route(d):
+        nonlocal err
         if d is None:
             return None
+        Cl = d.keys.shape[0]
+        if n > ROUTE_SLACK and ROUTE_SLACK * Cl >= _MIN_ROUTE_BUDGET * n:
+            dl, route_err = route_rows(d, axis, n, Kl)
+            err = err | (jax.lax.pmax(route_err.astype(jnp.int32), axis) > 0)
+            return dl
         g = jax.tree.map(lambda x: jax.lax.all_gather(x, axis, tiled=True), d)
         return _localize(g, base, Kl)
 
@@ -187,15 +283,135 @@ def _lower_join_sharded(op, node: Node, state, ins, axis: str, n: int
     out, new_state = join_core(op, Kl, Rl, node.spec.value_dtype,
                                core_state, da_l, db_l, key_offset=base)
     new_state["rcount"] = new_state["rcount"][None]
+    new_state["error"] = err
     return out, new_state
+
+
+def _lower_knn_sharded(op, node: Node, state, ins, axis: str, n: int
+                       ) -> Tuple[DeviceDelta, dict]:
+    """Corpus row-sharded k-NN: each shard scans its corpus slice, one
+    all_gather merges k candidates per query (SURVEY.md §2 item 14,
+    'sharded' aspiration of BASELINE config 4).
+
+    Layout: ``dvec``/``dlive`` sharded over the corpus axis; queries and
+    the emitted table replicated (every shard needs every query against
+    its slice, and the merged result is identical everywhere). Emission is
+    partitioned by query range so the egress delta stays row-sharded.
+    """
+    from reflow_tpu.executors.lowerings import _fold_vectors, _norm_rows
+    from reflow_tpu.kernels.topk import NEG, chunked_corpus_topk, topk
+
+    dq, dd = ins
+    if dq is None:
+        dq = DeviceDelta.empty(node.inputs[0].spec)
+    if dd is None:
+        dd = DeviceDelta.empty(node.inputs[1].spec)
+    Q = node.inputs[0].spec.key_space
+    D = node.inputs[1].spec.key_space
+    Ql, Dl = Q // n, D // n
+    k = op.k
+    base_q = (jax.lax.axis_index(axis) * Ql).astype(jnp.int32)
+    base_d = (jax.lax.axis_index(axis) * Dl).astype(jnp.int32)
+
+    # deltas are replicated by one gather: queries fold everywhere (the
+    # query table is replicated); docs fold only into the owned slice
+    gq = jax.tree.map(lambda x: jax.lax.all_gather(x, axis, tiled=True), dq)
+    gd = jax.tree.map(lambda x: jax.lax.all_gather(x, axis, tiled=True), dd)
+    gd_l = _localize(gd, base_d, Dl)
+
+    qvec, qlive = _fold_vectors(state["qvec"], state["qlive"], gq)
+    dvec, dlive = _fold_vectors(state["dvec"], state["dlive"], gd_l)
+    emitted, em_has = state["emitted"], state["em_has"]
+    prec = (jax.lax.Precision.HIGHEST if op.precision == "highest"
+            else jax.lax.Precision.DEFAULT)
+
+    # uniform across shards (computed from the gathered deltas), so every
+    # device takes the same lax.cond branch and collectives line up
+    need_full = jnp.any(gd.weights < 0) | jnp.any(gq.weights > 0)
+
+    def full_path(_):
+        chunk = min(op.scan_chunk, Dl)
+        vals_l, ids_l = chunked_corpus_topk(qvec, dvec, dlive, k, chunk,
+                                            precision=prec)
+        ids_g = jnp.where(vals_l <= NEG, -1, ids_l + base_d)
+        # merge: k candidates from each of the n shards, per query
+        cv = jax.lax.all_gather(vals_l, axis)        # [n, Q, k]
+        ci = jax.lax.all_gather(ids_g, axis)
+        cv = jnp.moveaxis(cv, 0, 1).reshape(Q, n * k)
+        ci = jnp.moveaxis(ci, 0, 1).reshape(Q, n * k)
+        # order by id so exact score ties resolve to the lowest doc id
+        order = jnp.argsort(jnp.where(ci < 0, jnp.iinfo(jnp.int32).max, ci),
+                            axis=1, stable=True)
+        ci = jnp.take_along_axis(ci, order, axis=1)
+        cv = jnp.take_along_axis(cv, order, axis=1)
+        vals, sel = topk(cv, k)
+        return vals, jnp.take_along_axis(ci, sel, axis=1)
+
+    def incr_path(_):
+        em_ids = emitted[:, :, 0].astype(jnp.int32)
+        em_vals = jnp.where(em_has[:, None] & (em_ids >= 0),
+                            emitted[:, :, 1], NEG)
+        # per-entry scores from the OWNED folded vectors (exactly the
+        # single-device dvec[di] semantics), combined with one pmax —
+        # non-owned entries contribute NEG
+        di = gd.keys
+        own = (di >= base_d) & (di < base_d + Dl)
+        di_l = jnp.where(own, di - base_d, 0)
+        s_loc = jnp.dot(qvec, dvec[di_l].T,
+                        preferred_element_type=jnp.float32,
+                        precision=prec)                        # [Q, Cd]
+        s_loc = jnp.where((own & (gd.weights > 0))[None, :], s_loc, NEG)
+        s_new = jax.lax.pmax(s_loc, axis)
+        cand_vals = jnp.concatenate([em_vals, s_new], axis=1)
+        cand_ids = jnp.concatenate(
+            [em_ids, jnp.broadcast_to(di, (Q, di.shape[0]))], axis=1)
+        order = jnp.argsort(cand_ids, axis=1, stable=True)
+        cand_ids = jnp.take_along_axis(cand_ids, order, axis=1)
+        cand_vals = jnp.take_along_axis(cand_vals, order, axis=1)
+        vals, sel = topk(cand_vals, k)
+        return vals, jnp.take_along_axis(cand_ids, sel, axis=1)
+
+    vals, ids = jax.lax.cond(need_full, full_path, incr_path, None)
+    ids = jnp.where(vals <= NEG, -1, ids)
+    new_row = jnp.stack([ids.astype(jnp.float32), vals], axis=-1)  # [Q,k,2]
+
+    changed = jnp.any(new_row != emitted, axis=(1, 2))
+    ins_m = qlive & (~em_has | changed)
+    ret_m = em_has & (~qlive | changed)
+    # replicated masks/table; each shard EMITS its owned query range so
+    # the egress delta is row-sharded like every other op's
+    sl = lambda x: jax.lax.dynamic_slice_in_dim(x, base_q, Ql, 0)
+    qkeys = base_q + jnp.arange(Ql, dtype=jnp.int32)
+    out = DeviceDelta(
+        keys=jnp.concatenate([qkeys, qkeys]),
+        values=jnp.concatenate([sl(emitted), sl(new_row)]),
+        weights=jnp.concatenate(
+            [-sl(ret_m).astype(jnp.int32), sl(ins_m).astype(jnp.int32)]),
+    )
+    new_emitted = jnp.where(ins_m[:, None, None], new_row, emitted)
+    new_has = jnp.where(ins_m, True, jnp.where(ret_m & ~qlive, False, em_has))
+    return out, {"qvec": qvec, "qlive": qlive, "dvec": dvec, "dlive": dlive,
+                 "emitted": new_emitted, "em_has": new_has}
+
+
+#: per-leaf shard_map specs for the knn state: corpus sharded, queries +
+#: emitted table replicated (consumed by ShardedTpuExecutor)
+def knn_state_specs(axis: str):
+    return {"qvec": None, "qlive": None, "dvec": axis, "dlive": axis,
+            "emitted": None, "em_has": None}
 
 
 def lower_node_sharded(node: Node, state, ins: Sequence[DeviceDelta],
                        axis: str, n: int) -> Tuple[DeviceDelta, dict]:
     kind = node.op.kind
     if kind == "reduce":
-        return _lower_reduce_sharded(node.op, node, state, ins, axis, n)
+        if node.op.how in LINEAR_DEVICE_REDUCERS:
+            return _lower_reduce_sharded(node.op, node, state, ins, axis, n)
+        return _lower_reduce_minmax_sharded(node.op, node, state, ins,
+                                            axis, n)
     if kind == "join":
         return _lower_join_sharded(node.op, node, state, ins, axis, n)
+    if kind == "knn":
+        return _lower_knn_sharded(node.op, node, state, ins, axis, n)
     # stateless row ops are shard-local
     return _LOWERINGS[kind](node.op, node, state, ins)

@@ -1,0 +1,180 @@
+"""Topo-partitioned execution: FlowGraph stages on separate devices.
+
+SURVEY.md §2 parallelism checklist — "graph topo-partitioning across
+chips" (the pipeline-parallel analog over the *dataflow graph*, not model
+layers). ``Node.stage`` assigns each operator to a contiguous topological
+stage; the :class:`StagedTpuExecutor` compiles ONE pass program per stage,
+pins each stage's operator state to its own device, and hands
+stage-boundary deltas to the next stage's device with an explicit
+``jax.device_put`` (the ICI hop).
+
+Pipelining falls out of XLA's async dispatch: each stage program runs on
+a different device, so once tick ``t``'s stage 0 has been dispatched the
+host immediately dispatches stage 1 while stage 0 of tick ``t+1`` can
+start — the classic 1F pipeline schedule without any bespoke scheduler
+(the host is the pipeline driver; device queues are the pipeline).
+
+Validation (at bind): every DAG edge must be stage-monotone
+(``stage(src) <= stage(dst)``), and a loop's entire cyclic region must
+live inside one stage (pipelining across a fixpoint is not meaningful).
+Unassigned nodes inherit stage 0; sources/loops take the minimum stage of
+their consumers, sinks the stage of their producer.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+
+from reflow_tpu.delta import DeltaBatch
+from reflow_tpu.executors.device_delta import DeviceDelta, to_device
+from reflow_tpu.executors.tpu import TpuExecutor
+from reflow_tpu.graph import FlowGraph, GraphError, Node
+
+__all__ = ["StagedTpuExecutor"]
+
+
+class StagedTpuExecutor(TpuExecutor):
+    name = "staged"
+
+    def __init__(self, devices: Optional[Sequence] = None):
+        # the on-device fixpoint fuses a whole tick into one program on
+        # one device — incompatible with cross-device staging, so staged
+        # graphs with loops use the scheduler's host-driven loop (the
+        # loop's region still runs on its stage's device each pass)
+        super().__init__(fixpoint=False, linear_fixpoint=False)
+        self._devices = list(devices) if devices is not None else None
+
+    # -- bind: stage assignment, validation, per-stage state placement ----
+
+    def bind(self, graph: FlowGraph) -> None:
+        super().bind(graph)
+        stage_of: Dict[int, int] = {}
+        for node in graph.nodes:
+            if node.kind == "op":
+                stage_of[node.id] = node.stage if node.stage is not None else 0
+        # sources/loops ride with their first consumer; sinks with their
+        # producer; isolated nodes default to stage 0
+        for node in graph.nodes:
+            if node.kind in ("source", "loop"):
+                cons = [stage_of.get(c.id, 0)
+                        for c, _ in graph.consumers(node)]
+                stage_of[node.id] = min(cons) if cons else 0
+            elif node.kind == "sink":
+                stage_of[node.id] = stage_of.get(node.inputs[0].id, 0)
+        for node in graph.nodes:
+            for inp in node.inputs:
+                if stage_of[inp.id] > stage_of[node.id]:
+                    raise GraphError(
+                        f"edge {inp} -> {node} goes backwards in stages "
+                        f"({stage_of[inp.id]} -> {stage_of[node.id]}); "
+                        f"stages must be monotone along dataflow edges")
+        # each loop's OWN cyclic region must live inside one stage
+        # (independent loops may live in different stages)
+        for loop in graph.loops:
+            if loop.back_input is None:
+                continue
+            fwd = {loop.id}
+            changed = True
+            while changed:
+                changed = False
+                for nd in graph.nodes:
+                    if nd.id not in fwd and any(i.id in fwd
+                                                for i in nd.inputs):
+                        fwd.add(nd.id)
+                        changed = True
+            back = {loop.back_input.id}
+            changed = True
+            while changed:
+                changed = False
+                for nd in graph.nodes:
+                    if nd.id in back:
+                        for i in nd.inputs:
+                            if i.id not in back:
+                                back.add(i.id)
+                                changed = True
+            region = (fwd & back) | {loop.id}
+            stages = {stage_of[nid] for nid in region}
+            if len(stages) > 1:
+                raise GraphError(
+                    f"{loop}'s cyclic region spans stages {sorted(stages)}; "
+                    f"a fixpoint region must live inside one stage")
+        self._stage_of = stage_of
+        self._stage_list = sorted(set(stage_of.values()))
+
+        devs = self._devices if self._devices is not None else jax.devices()
+        self._dev = {s: devs[i % len(devs)]
+                     for i, s in enumerate(self._stage_list)}
+
+        # pin each op's state to its stage's device
+        for nid, st in self.states.items():
+            dev = self._dev[stage_of[nid]]
+            self.states[nid] = jax.device_put(st, dev)
+
+        # per-stage boundary egress: nodes with a consumer in a LATER
+        # stage must be returned by their stage's program
+        self._boundary_of: Dict[int, List[int]] = {s: [] for s in
+                                                   self._stage_list}
+        for node in graph.nodes:
+            if node.kind == "sink":
+                continue
+            s = stage_of[node.id]
+            if any(stage_of[c.id] > s for c, _ in graph.consumers(node)):
+                self._boundary_of[s].append(node.id)
+        # pre-compile the arena-GC kernel so a join's first high-water
+        # compaction never pays a compile mid-stream
+        self.warm_gc()
+
+    # -- the staged pass ---------------------------------------------------
+
+    def run_pass(self, plan: Sequence[Node],
+                 ingress: Dict[int, DeltaBatch]) -> Dict[int, object]:
+        stage_of = self._stage_of
+        dev_ingress: Dict[int, DeviceDelta] = {}
+        for nid, b in ingress.items():
+            d = (b if isinstance(b, DeviceDelta)
+                 else to_device(b, self.graph.nodes[nid].spec))
+            # uploads land directly on the consuming stage's device
+            dev_ingress[nid] = jax.device_put(d, self._dev[stage_of[nid]])
+
+        self._track_arena(plan, {nid: d.capacity
+                                 for nid, d in dev_ingress.items()})
+
+        outs: Dict[int, DeviceDelta] = dict(dev_ingress)
+        egress: Dict[int, object] = {}
+        sink_inputs = {s.inputs[0].id: s.id for s in self.graph.sinks}
+        back_edges = {l.back_input.id: l.id for l in self.graph.loops
+                      if l.back_input is not None}
+        for s in self._stage_list:
+            sub = [n for n in plan if stage_of[n.id] == s]
+            if not sub:
+                continue
+            # seeds: anything already computed (external ingress or an
+            # earlier stage's boundary egress) that this stage consumes
+            # or that seeds one of its nodes — moved to this stage's
+            # device (the pipeline handoff)
+            need = {i.id for n in sub for i in n.inputs} | {n.id for n in sub}
+            seeds = {nid: jax.device_put(d, self._dev[s])
+                     for nid, d in outs.items() if nid in need}
+            if not seeds:
+                continue
+            sig = ("stage", s, tuple(n.id for n in sub),
+                   tuple(sorted((nid, d.capacity)
+                                for nid, d in seeds.items())))
+            fn = self._cache.get(sig)
+            if fn is None:
+                fn = jax.jit(
+                    self.build_pass_fn(sub, self._boundary_of[s]),
+                    donate_argnums=0)
+                self._cache[sig] = fn
+            stage_states = {nid: st for nid, st in self.states.items()
+                            if stage_of[nid] == s}
+            new_states, stage_eg = fn(stage_states, seeds)
+            self.states.update(new_states)
+            for nid, d in stage_eg.items():
+                if nid in sink_inputs.values() or nid in back_edges.values():
+                    egress[nid] = d
+                else:
+                    outs[nid] = d         # boundary -> later stages
+        return egress

@@ -33,8 +33,8 @@ def _split_states(states: Dict[int, object]):
 
     arr, host = {}, {}
     for nid, st in states.items():
-        if isinstance(st, dict) and st and all(
-                isinstance(v, jax.Array) for v in st.values()):
+        leaves = jax.tree.leaves(st) if isinstance(st, dict) else []
+        if leaves and all(isinstance(v, jax.Array) for v in leaves):
             arr[str(nid)] = st
         else:
             host[nid] = st

@@ -1,0 +1,93 @@
+"""Fault injection for source delivery (SURVEY.md §5: failure testing).
+
+Models a lossy at-least-once transport between an upstream producer and a
+graph source: batches can be **dropped** (and retransmitted later),
+**duplicated** (retransmitted although already delivered), and
+**reordered** (delivered out of send order within a bounded window).
+
+The scheduler's idempotent ``push(batch_id=...)`` dedup plus the
+transport's retransmission makes the composition exactly-once: after
+``flush()`` every batch has been folded into the graph exactly once, so a
+faulty run's sink views must equal a clean run's — the property the
+fault-injection tests assert.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from reflow_tpu.delta import DeltaBatch
+from reflow_tpu.graph import Node
+
+__all__ = ["FaultyChannel"]
+
+
+class FaultyChannel:
+    """At-least-once delivery of source batches with injected faults.
+
+    ``send`` enqueues a batch; each call then attempts delivery of some
+    enqueued batches with faults applied. A batch stays queued until a
+    delivery attempt is "acked" (survives the drop roll), so nothing is
+    ever lost — only delayed, repeated, or reordered. Call ``flush()``
+    before the final tick to force the tail retransmissions.
+    """
+
+    def __init__(self, sched, source: Node, *, drop_p: float = 0.3,
+                 dup_p: float = 0.3, reorder_window: int = 4, seed: int = 0):
+        self.sched = sched
+        self.source = source
+        self.drop_p = drop_p
+        self.dup_p = dup_p
+        self.reorder_window = reorder_window
+        self.rng = np.random.default_rng(seed)
+        self._unacked: List[Tuple[str, DeltaBatch]] = []
+        self._delivered_ids: List[str] = []   # for duplicate injection
+        self.stats = {"delivered": 0, "dropped": 0, "duplicated": 0,
+                      "reordered": 0}
+        self._batches = {}
+
+    def send(self, batch: DeltaBatch, batch_id: str) -> None:
+        self._unacked.append((batch_id, batch))
+        self._batches[batch_id] = batch
+        self._pump()
+
+    def _pump(self) -> None:
+        # reorder: deliver from a window at a random position
+        while self._unacked:
+            w = min(self.reorder_window, len(self._unacked))
+            i = int(self.rng.integers(0, w))
+            if i != 0:
+                self.stats["reordered"] += 1
+            bid, batch = self._unacked[i]
+            if self.rng.random() < self.drop_p:
+                # this transmission is lost in flight; the batch stays
+                # queued for retransmission
+                self.stats["dropped"] += 1
+                if self.rng.random() < 0.5:
+                    break  # transport stalls until the next send/flush
+                continue
+            self.sched.push(self.source, batch, batch_id=bid)
+            self.stats["delivered"] += 1
+            self._delivered_ids.append(bid)
+            del self._unacked[i]
+            # duplicate: retransmit an already-delivered batch (the
+            # upstream never got the ack); the dedup set must drop it
+            if self._delivered_ids and self.rng.random() < self.dup_p:
+                dup = self._delivered_ids[
+                    int(self.rng.integers(0, len(self._delivered_ids)))]
+                accepted = self.sched.push(self.source, self._batches[dup],
+                                           batch_id=dup)
+                assert not accepted, "duplicate batch was folded twice"
+                self.stats["duplicated"] += 1
+            if self.rng.random() < 0.3:
+                break  # partial progress per pump
+
+    def flush(self) -> None:
+        """Retransmit until every batch has been delivered exactly once."""
+        while self._unacked:
+            bid, batch = self._unacked.pop(0)
+            self.sched.push(self.source, batch, batch_id=bid)
+            self.stats["delivered"] += 1
+            self._delivered_ids.append(bid)

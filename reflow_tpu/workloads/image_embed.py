@@ -49,11 +49,17 @@ def build_graph(n_images: int, n_groups: int, params: Dict) -> ImageEmbedGraph:
     g = FlowGraph("image_embed")
     src = g.source("images", Spec((1 + flat,), f32, key_space=n_images))
 
-    def embed(v):  # [C, 1+flat] -> [C, 1+dim]
-        feats = vit_forward(params, v[:, 1:])
+    # weights ride as op params (compiled-program ARGUMENTS: VERDICT r2 #2
+    # — closing over them traced ~86M ViT-B floats into a ~350MB HLO and
+    # meant full recompilation on any weight change); only the static
+    # shape-driving config is closed over
+    weights = {k: v for k, v in params.items() if k != "_cfg"}
+
+    def embed(p, v):  # (weights, [C, 1+flat]) -> [C, 1+dim]
+        feats = vit_forward({**p, "_cfg": cfg}, v[:, 1:])
         return jnp.concatenate([v[:, :1], feats], axis=-1)
 
-    emb = g.map(src, embed, vectorized=True,
+    emb = g.map(src, embed, vectorized=True, params=weights,
                 spec=Spec((1 + dim,), f32, key_space=n_images), name="embed")
     by_grp = g.group_by(emb, key_fn=lambda k, v: v[0],
                         value_fn=lambda k, v: v[1:],

@@ -11,10 +11,10 @@ op vocabulary (no Join), so it lowers to both executors and shards:
     doctok  = Reduce(sum)(GroupBy(doc, 1)(src))             {doc: tokens}
     ndocs   = Reduce(sum)(GroupBy(0, 1)(doctok-emissions))  {0: N}
 
-The presence trick: ``Reduce('mean')`` over the constant per-pair value
-``term`` emits exactly one insert when a (doc, term) pair first appears
-and one retract when its count reaches zero — tf changes in between leave
-the mean unchanged and are suppressed. Grouping those +-1 presence rows by
+The presence trick: ``Reduce('mean')`` over a constant per-pair value
+emits exactly one insert when a (doc, term) pair first appears and one
+retract when its count reaches zero — tf changes in between leave the
+mean unchanged and are suppressed. Grouping those +-1 presence rows by
 term and summing gives the document frequency incrementally. The same
 telescoping applied to ``doctok``'s emissions (every live doc nets exactly
 one row) counts distinct documents.
@@ -23,10 +23,14 @@ one row) counts distinct documents.
 (host side) from the three maintained tables — the graph keeps the
 decomposition incremental; the final scalar combine is O(changed rows).
 
-Exactness bound (device path): the mean-reduce stores ``term * tf`` in a
-float32 running sum, so ``n_terms * max_tf`` must stay below 2**24. The
-builder enforces n_terms <= 2**14 by default (max_tf 1024 — far beyond any
-real document's per-term count).
+Exactness bound (device path): the mean-reduce keeps a float32 running
+sum of ``component * tf`` per pair, so each stored component must satisfy
+``component * max_tf < 2**24``. Storing the raw term id would cap the
+vocabulary at 2**14 (VERDICT r2: a real Wikipedia vocabulary is ~10^6);
+instead the presence value is the term id split radix-``_TERM_RADIX``
+into two small components ``[term // R, term % R]`` (each < 4096), and
+the by-term GroupBy reassembles ``term = v0*R + v1``. That lifts the
+vocabulary bound to 2**24 terms at max per-document term count 4096.
 """
 
 from __future__ import annotations
@@ -58,24 +62,41 @@ class TfidfGraph:
     ndocs: Node    # read_table -> {0: N}
 
 
+#: radix for splitting term ids into two f32-exact presence components
+_TERM_RADIX = 4096
+
+
+def _split_term(v):
+    """[C, 2] (term, doc) -> [C, 2] (term // R, term % R); dual contract
+    (NumPy on the CPU oracle, jnp under the device lowering)."""
+    if isinstance(v, np.ndarray):
+        xp = np
+    else:
+        import jax.numpy as xp
+    t = v[:, 0]
+    hi = t // _TERM_RADIX
+    return xp.stack([hi, t - hi * _TERM_RADIX], axis=-1)
+
+
 def build_graph(n_pairs: int, n_terms: int, n_docs: int,
                 *, n0: int = 8) -> TfidfGraph:
-    if n_terms > 1 << 14:
+    if n_terms > 1 << 24:
         raise ValueError(
-            f"n_terms {n_terms} > 2**14 would overflow the float32 "
-            f"presence sum (see module docstring)")
+            f"n_terms {n_terms} > 2**24 would overflow the float32 "
+            f"radix-split presence components (see module docstring)")
     f32 = np.float32
     g = FlowGraph("tfidf")
     src = g.source("tokens", Spec((2,), f32, key_space=n_pairs))
     ones = g.map(src, lambda v: 1.0, spec=Spec((), f32, key_space=n_pairs),
                  name="ones")
     tf = g.reduce(ones, "sum", name="tf")
-    term_of = g.map(src, lambda v: v[0],
-                    spec=Spec((), f32, key_space=n_pairs), name="term_of")
+    term_of = g.map(src, _split_term, vectorized=True,
+                    spec=Spec((2,), f32, key_space=n_pairs), name="term_of")
     pres = g.reduce(term_of, "mean", name="pair_presence")
-    bterm = g.group_by(pres, key_fn=lambda k, v: v,
-                       value_fn=lambda k, v: 1.0,
-                       spec=Spec((), f32, key_space=n_terms), name="by_term")
+    bterm = g.group_by(
+        pres, key_fn=lambda k, v: v[0] * _TERM_RADIX + v[1],
+        value_fn=lambda k, v: 1.0,
+        spec=Spec((), f32, key_space=n_terms), name="by_term")
     df = g.reduce(bterm, "sum", name="df")
     bdoc = g.group_by(src, key_fn=lambda k, v: v[1],
                       value_fn=lambda k, v: 1.0,

@@ -211,3 +211,42 @@ def test_fixpoint_declines_loop_carried_arena():
     g.close_loop(x, nxt)
     g.validate()
     assert analyze(g) is None
+
+
+def test_fault_injection_exactly_once():
+    """SURVEY.md §5 fault hook: drop/duplicate/reorder source delivery
+    under at-least-once retransmission + idempotent push == exactly-once;
+    the faulty run's view must equal the clean run's."""
+    import numpy as np
+
+    from reflow_tpu import DeltaBatch, DirtyScheduler
+    from reflow_tpu.utils.faults import FaultyChannel
+    from reflow_tpu.workloads import wordcount
+
+    def batches(rng):
+        out = []
+        for i in range(30):
+            n = int(rng.integers(3, 10))
+            words = [f"w{int(x)}" for x in rng.integers(0, 40, n)]
+            out.append((f"b{i}", wordcount.ingest_lines([" ".join(words)])))
+        return out
+
+    g1, src1, sink1 = wordcount.build_graph()
+    clean = DirtyScheduler(g1)
+    for bid, b in batches(np.random.default_rng(2)):
+        clean.push(src1, b, batch_id=bid)
+        clean.tick()
+
+    g2, src2, sink2 = wordcount.build_graph()
+    faulty = DirtyScheduler(g2)
+    chan = FaultyChannel(faulty, src2, drop_p=0.4, dup_p=0.4,
+                         reorder_window=4, seed=7)
+    for bid, b in batches(np.random.default_rng(2)):
+        chan.send(b, batch_id=bid)
+        faulty.tick()
+    chan.flush()
+    faulty.tick()
+
+    assert chan.stats["dropped"] > 0, "no faults were injected"
+    assert chan.stats["duplicated"] > 0
+    assert dict(clean.view(sink1.name)) == dict(faulty.view(sink2.name))

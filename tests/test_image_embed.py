@@ -68,3 +68,58 @@ def test_vit_b_config_builds():
     p = iv(1, **{**VIT_B_16, "depth": 1})  # one block: keep CI light
     assert p["proj_w"].shape == (16 * 16 * 3, 768)
     assert p["blocks"][0]["w1"].shape == (768, 3072)
+
+
+def test_compiled_program_embeds_no_params():
+    """VERDICT r2 #2: ViT weights must enter the tick program as
+    ARGUMENTS, not traced constants — the lowered HLO's size must not
+    scale with the model size."""
+    import jax
+
+    from reflow_tpu.executors.fixpoint import _abstract_delta
+    from reflow_tpu.executors.tpu import TpuExecutor
+
+    big = dict(VIT_TINY, dim=256, mlp_dim=1024)  # ~64x the parameters
+
+    def hlo_len(cfg):
+        p = init_vit(0, **cfg)
+        ig = image_embed.build_graph(N_IMG, N_GRP, p)
+        ig.graph.validate()
+        ex = TpuExecutor()
+        ex.bind(ig.graph)
+        fn = jax.jit(ex.build_pass_fn(list(ig.graph.nodes)))
+        states_abs = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), ex.states)
+        ingress = {ig.images.id: _abstract_delta(ig.images.spec, 64)}
+        return len(fn.lower(states_abs, ingress).as_text())
+
+    tiny, bigger = hlo_len(VIT_TINY), hlo_len(big)
+    assert bigger < 1.5 * tiny, (
+        f"HLO grew {bigger / tiny:.1f}x with a 64x model: params are being "
+        f"traced as constants")
+
+
+def test_update_params_swaps_without_recompile(params):
+    """Params are arguments: swapping them changes results on the next
+    tick and compiles nothing new."""
+    ig = image_embed.build_graph(N_IMG, N_GRP, params)
+    ex = get_executor("tpu")
+    sched = DirtyScheduler(ig.graph, ex)
+    stream = image_embed.ImageStream(params, seed=4)
+    sched.push(ig.images, stream.insert(np.arange(8), np.zeros(8, int)))
+    sched.tick()
+    before = dict(sched.read_table(ig.centroids))
+    n_programs = len(ex._cache)
+
+    params2 = init_vit(1, **VIT_TINY)  # different weights, same shapes
+    embed_node = ig.graph.nodes[1]
+    assert embed_node.name == "embed"
+    ex.update_params(embed_node, {k: v for k, v in params2.items()
+                                  if k != "_cfg"})
+    # replay the same rows so the centroid recomputes under new weights
+    batch = stream.insert(np.arange(8, 16), np.zeros(8, int))
+    sched.push(ig.images, batch)
+    sched.tick()
+    after = dict(sched.read_table(ig.centroids))
+    assert len(ex._cache) == n_programs, "param swap forced a recompile"
+    assert not np.allclose(np.asarray(after[0]), np.asarray(before[0]))

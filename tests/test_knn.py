@@ -95,3 +95,27 @@ def test_query_retraction_removes_row():
                                       -np.ones(3, np.int64)))
     sched.tick()
     assert len(sched.read_table(kg.index)) == Q - 3
+
+
+def test_sharded_knn_matches_single_device():
+    """VERDICT r2 item 7: corpus row-sharded k-NN on the 8-device mesh —
+    per-shard chunked scan + all_gather candidate merge — must reproduce
+    the single-device tables exactly (incremental AND rescan paths)."""
+    from reflow_tpu.parallel import make_mesh
+    from reflow_tpu.parallel.shard import ShardedTpuExecutor
+
+    mesh = make_mesh(8)
+    s_sh, kg_sh, store, qvecs = _drive(ShardedTpuExecutor(mesh), seed=6)
+    s_tp, kg_tp, _, _ = _drive(get_executor("tpu"), seed=6)
+    t_sh = s_sh.read_table(kg_sh.index)
+    t_tp = s_tp.read_table(kg_tp.index)
+    assert set(t_sh) == set(t_tp)
+    for q in t_tp:
+        a, b = np.asarray(t_sh[q]), np.asarray(t_tp[q])
+        np.testing.assert_array_equal(a[:, 0], b[:, 0])  # ids exact
+        # scores: per-shard contraction order differs by ~1 ulp
+        np.testing.assert_allclose(a[:, 1], b[:, 1], rtol=1e-5)
+    ref_ids, _ = store.reference_topk(qvecs, K)
+    for q in range(Q):
+        np.testing.assert_array_equal(
+            np.asarray(t_sh[q])[:, 0].astype(np.int64), ref_ids[q])

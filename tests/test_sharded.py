@@ -149,3 +149,111 @@ def test_sharded_route_overflow_surfaces(mesh):
                             np.ones(n, np.int64)))
     with pytest.raises(RuntimeError, match="route overflow"):
         sh.tick()
+
+
+def test_sharded_linear_fixpoint_engages(mesh):
+    """VERDICT r2 item 5: the fused delta-vector loop must actually run on
+    the sharded executor (not silently fall back to the row program), and
+    match the single-device executor bit-for-bit on the ranks table."""
+    from reflow_tpu.workloads import pagerank
+
+    N, E = 64, 512
+    results = {}
+    for name, ex in (("sharded", ShardedTpuExecutor(mesh)),
+                     ("single", TpuExecutor())):
+        web = pagerank.WebGraph.random(N, E, seed=21)
+        pg = pagerank.build_graph(N, tol=1e-6, arena_capacity=1 << 13)
+        sched = DirtyScheduler(pg.graph, ex, max_loop_iters=500)
+        sched.push(pg.teleport, pagerank.teleport_batch(N))
+        sched.push(pg.edges, web.initial_batch())
+        r = sched.tick()
+        assert r.quiesced
+        for _ in range(2):
+            sched.push(pg.edges, web.churn(0.05))
+            assert sched.tick().quiesced
+        assert ex._linear_fixpoint, f"{name}: fused loop fell back"
+        assert ex._linear_structure is not None
+        results[name] = sched.read_table(pg.new_rank)
+    assert set(results["sharded"]) == set(results["single"])
+    for k in results["single"]:
+        a = np.asarray(results["sharded"][k], np.float32)
+        b = np.asarray(results["single"][k], np.float32)
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_sharded_join_routed_path_differential(mesh):
+    """Large deltas take the routed (all_to_all) join path — per-dest
+    budget >= _MIN_ROUTE_BUDGET rows — and must match the CPU oracle."""
+    K = 1024
+    rows = 2048  # Cl=256/shard, budget=128: routing engages on n=8
+    spec = Spec((), np.float32, key_space=K)
+
+    def build():
+        g = FlowGraph("join")
+        left_src = g.source("L", spec)
+        right_src = g.source("R", spec)
+        lt = g.reduce(left_src, "sum", name="lt")   # unique-keyed left
+        j = g.join(lt, right_src, merge=lambda k, x, y: x + y,
+                   spec=spec, name="j", arena_capacity=1 << 15)
+        g.sink(j, "out")
+        return g, left_src, right_src
+
+    rng = np.random.default_rng(5)
+    outs = []
+    for ex in (ShardedTpuExecutor(mesh), CpuExecutor()):
+        g, ls, rs = build()
+        sched = DirtyScheduler(g, ex)
+        r = np.random.default_rng(5)
+        lk = r.integers(0, K, rows)
+        sched.push(ls, DeltaBatch(
+            lk, r.integers(0, 100, rows).astype(np.float32),
+            np.ones(rows, np.int64)))
+        sched.tick()
+        rk = r.integers(0, K, rows)
+        sched.push(rs, DeltaBatch(
+            rk, r.integers(0, 100, rows).astype(np.float32),
+            np.ones(rows, np.int64)))
+        sched.tick()
+        # second right batch incl. retractions of the first
+        sched.push(rs, DeltaBatch(rk[:rows // 2],
+                                  np.zeros(rows // 2, np.float32),
+                                  -np.ones(rows // 2, np.int64)))
+        sched.tick()
+        outs.append(dict(sched.view("out")))
+    a, b = outs
+    assert set(a) == set(b)
+    for k in a:
+        assert a[k] == b[k], (k, a[k], b[k])
+
+
+def test_sharded_minmax_matches_cpu_insert_only(mesh):
+    """Sharded min/max: scatter-extrema + pmax combine, insert-only; a
+    retraction trips the sticky error like the single-device path."""
+    K = 64
+    spec = Spec((), np.float32, key_space=K)
+    for how in ("min", "max"):
+        g = FlowGraph(how)
+        src = g.source("s", spec)
+        g.sink(g.reduce(src, how, name="m"), "out")
+        g2 = FlowGraph(how)
+        src2 = g2.source("s", spec)
+        g2.sink(g2.reduce(src2, how, name="m"), "out")
+        sh = DirtyScheduler(g, ShardedTpuExecutor(mesh))
+        cp = DirtyScheduler(g2, CpuExecutor())
+        rng1, rng2 = np.random.default_rng(8), np.random.default_rng(8)
+        for sched, src_n, rng in ((sh, src, rng1), (cp, src2, rng2)):
+            for _ in range(3):
+                n = 96
+                sched.push(src_n, DeltaBatch(
+                    rng.integers(0, K, n),
+                    rng.integers(-50, 50, n).astype(np.float32),
+                    np.ones(n, np.int64)))
+                sched.tick()
+        a = {int(k): float(v) for k, v in sh.view_dict("out").items()}
+        b = {int(k): float(v) for k, v in cp.view_dict("out").items()}
+        assert a == b, how
+    # retraction -> sticky error surfaced
+    sh.push(src, DeltaBatch(np.array([1]), np.array([0.0], np.float32),
+                            np.array([-1], np.int64)))
+    with pytest.raises(RuntimeError, match="min/max"):
+        sh.tick()

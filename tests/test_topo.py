@@ -1,0 +1,90 @@
+"""Topo-partitioned (staged) execution: per-stage programs on separate
+devices with seeded-ingress handoff, differential vs unpartitioned
+(SURVEY.md §2 parallelism checklist — the pp analog)."""
+
+import numpy as np
+import pytest
+
+from reflow_tpu import DeltaBatch, DirtyScheduler, FlowGraph, Spec
+from reflow_tpu.executors import CpuExecutor
+from reflow_tpu.executors.tpu import TpuExecutor
+from reflow_tpu.graph import GraphError
+from reflow_tpu.parallel.topo import StagedTpuExecutor
+
+K = 64
+
+
+def _two_stage_graph():
+    """Stage 0: map+reduce; stage 1: join against a second source."""
+    spec = Spec((), np.float32, key_space=K)
+    g = FlowGraph("staged")
+    src = g.source("src", spec)
+    doubled = g.map(src, lambda v: 2.0 * v, vectorized=True, name="x2")
+    totals = g.reduce(doubled, "sum", name="totals")
+    rsrc = g.source("right", spec)
+    j = g.join(totals, rsrc, merge=lambda k, a, b: a + b, spec=spec,
+               name="j", arena_capacity=1 << 10)
+    g.sink(j, "out")
+    for node in (doubled, totals):
+        node.stage = 0
+    j.stage = 1
+    return g, src, rsrc
+
+
+def _drive(sched, src, rsrc):
+    rng = np.random.default_rng(3)
+    views = []
+    for t in range(3):
+        n = 40 + 10 * t
+        sched.push(src, DeltaBatch(rng.integers(0, K, n),
+                                   rng.integers(1, 9, n).astype(np.float32),
+                                   np.where(rng.random(n) < 0.2, -1, 1)))
+        kb = rng.integers(0, K, 16)
+        sched.push(rsrc, DeltaBatch(kb, np.ones(16, np.float32),
+                                    np.ones(16, np.int64)))
+        sched.tick()
+        views.append({(int(k), float(v)): int(w)
+                      for (k, v), w in sched.view("out").items()})
+    return views
+
+
+def test_staged_matches_unpartitioned_and_cpu():
+    import jax
+
+    outs = {}
+    for name, ex in (("staged", StagedTpuExecutor()),
+                     ("tpu", TpuExecutor()), ("cpu", CpuExecutor())):
+        g, src, rsrc = _two_stage_graph()
+        outs[name] = _drive(DirtyScheduler(g, ex), src, rsrc)
+    assert outs["staged"] == outs["tpu"] == outs["cpu"]
+
+
+def test_staged_states_live_on_stage_devices():
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    g, src, rsrc = _two_stage_graph()
+    ex = StagedTpuExecutor()
+    sched = DirtyScheduler(g, ex)
+    _drive(sched, src, rsrc)
+    totals = next(n for n in g.nodes if n.name == "totals")
+    j = next(n for n in g.nodes if n.name == "j")
+    dev_of = lambda st: next(iter(
+        jax.tree.leaves(st)[0].devices()))
+    assert dev_of(ex.states[totals.id]) == jax.devices()[0]
+    assert dev_of(ex.states[j.id]) == jax.devices()[1]
+    assert dev_of(ex.states[totals.id]) != dev_of(ex.states[j.id])
+
+
+def test_staged_rejects_backwards_stage_edge():
+    spec = Spec((), np.float32, key_space=K)
+    g = FlowGraph("bad")
+    src = g.source("s", spec)
+    a = g.map(src, lambda v: v, vectorized=True, name="a")
+    r = g.reduce(a, "sum", name="r")
+    g.sink(r, "out")
+    a.stage = 1
+    r.stage = 0   # consumes stage-1 output in stage 0: backwards
+    with pytest.raises(GraphError, match="backwards in stages"):
+        DirtyScheduler(g, StagedTpuExecutor())
