@@ -23,8 +23,8 @@ over smaller sizes (stderr) showing the per-row rate is flat-to-declining
 in graph size, so extrapolating the 200k-edge rate to 1M edges is
 conservative for the speedup claim. ``REFLOW_BENCH_CPU_FULL=1`` instead
 measures the CPU executor at the full 1M-edge config (cold build alone
-exceeds 25 minutes of pure-Python fixpoint; measured once offline —
-see README's benchmark notes).
+costs ~15 minutes of pure-Python fixpoint — 921s measured offline; see
+README's benchmark notes).
 
 Env knobs::
 
@@ -36,6 +36,7 @@ Env knobs::
     REFLOW_BENCH_CPU_EDGES_CAP    CPU measured at <= this many edges
     REFLOW_BENCH_CPU_FULL=1       CPU at full scale (overrides cap; slow)
     REFLOW_BENCH_ALL=0            skip configs 1/2/4/5 (default: run them)
+    REFLOW_BENCH_TRACE=<dir>      xprof device trace of one churn tick
 """
 
 from __future__ import annotations
@@ -103,6 +104,15 @@ def run_pagerank(executor: str, n_nodes: int, n_edges: int, churn: float,
         wall, res = _synced_tick(sched)
         walls.append(wall)
         dops.append(res.delta_ops)
+    trace_dir = os.environ.get("REFLOW_BENCH_TRACE")
+    if trace_dir and executor != "cpu":
+        # xprof device trace of ONE extra steady-state churn tick, kept
+        # out of the measured walls (trace start/stop + dump I/O would
+        # distort the very metric being diagnosed)
+        from reflow_tpu.utils.metrics import profile_trace
+        sched.push(pr.edges, web.churn(churn))
+        with profile_trace(trace_dir):
+            _synced_tick(sched)
 
     # streaming: pipelined ticks, one sync per batch — the delta-ops/s
     # throughput a streaming deployment sees

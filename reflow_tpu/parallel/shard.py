@@ -28,7 +28,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from reflow_tpu.executors.device_delta import MIN_CAPACITY, DeviceDelta
 from reflow_tpu.executors.tpu import TpuExecutor
 from reflow_tpu.graph import FlowGraph, GraphError, Node
-from reflow_tpu.parallel.mesh import make_mesh, replicate, shard_state_tree
+from reflow_tpu.parallel.mesh import make_mesh, replicate
 from reflow_tpu.parallel.shard_lowerings import lower_node_sharded
 
 __all__ = ["ShardedTpuExecutor"]
@@ -104,26 +104,17 @@ class ShardedTpuExecutor(TpuExecutor):
                 # delta sides to key owners via all_to_all)
                 self.states[node.id]["rcount"] = jnp.zeros((n,), jnp.int32)
                 self.states[node.id]["error"] = jnp.zeros((), jnp.bool_)
+        # placement derives from the SAME per-leaf specs shard_map uses
+        # (one source of truth: _state_tree_specs), so the bound layout
+        # can never disagree with the pass programs' in_specs
         from jax.sharding import NamedSharding
-        from reflow_tpu.parallel.shard_lowerings import knn_state_specs
 
-        knn_axes = knn_state_specs(self.axis)
-
-        def _place(nid, st):
-            if nid in self._replicated_ids:
-                return replicate(st, self.mesh)
-            if nid in self._knn_ids:
-                # per-leaf: corpus sharded, queries/emission replicated —
-                # the dim-0 heuristic would wrongly shard a query table
-                # whose capacity happens to divide the mesh
-                return {k: jax.device_put(v, NamedSharding(
-                            self.mesh, P(knn_axes[k])
-                            if knn_axes[k] else P()))
-                        for k, v in st.items()}
-            return shard_state_tree(st, self.mesh, axis_name=self.axis)
-
-        self.states = {nid: _place(nid, st)
-                       for nid, st in self.states.items()}
+        specs = self._state_tree_specs(self.states)
+        self.states = {
+            nid: jax.tree.map(
+                lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+                st, specs[nid])
+            for nid, st in self.states.items()}
         self.warm_gc()
 
     def _state_spec(self, x) -> P:

@@ -329,23 +329,36 @@ def _lower_knn_sharded(op, node: Node, state, ins, axis: str, n: int
     # device takes the same lax.cond branch and collectives line up
     need_full = jnp.any(gd.weights < 0) | jnp.any(gq.weights > 0)
 
-    def full_path(_):
-        chunk = min(op.scan_chunk, Dl)
-        vals_l, ids_l = chunked_corpus_topk(qvec, dvec, dlive, k, chunk,
-                                            precision=prec)
-        ids_g = jnp.where(vals_l <= NEG, -1, ids_l + base_d)
-        # merge: k candidates from each of the n shards, per query
-        cv = jax.lax.all_gather(vals_l, axis)        # [n, Q, k]
-        ci = jax.lax.all_gather(ids_g, axis)
-        cv = jnp.moveaxis(cv, 0, 1).reshape(Q, n * k)
-        ci = jnp.moveaxis(ci, 0, 1).reshape(Q, n * k)
-        # order by id so exact score ties resolve to the lowest doc id
+    def _merge2(av, ai, bv, bi):
+        """Merge two [Q, k] candidate sets; ties break to the lowest id.
+
+        (score desc, id asc) is a total order, so pairwise merging is
+        associative and the ring result matches a flat n*k sort."""
+        cv = jnp.concatenate([av, bv], axis=1)
+        ci = jnp.concatenate([ai, bi], axis=1)
         order = jnp.argsort(jnp.where(ci < 0, jnp.iinfo(jnp.int32).max, ci),
                             axis=1, stable=True)
         ci = jnp.take_along_axis(ci, order, axis=1)
         cv = jnp.take_along_axis(cv, order, axis=1)
         vals, sel = topk(cv, k)
         return vals, jnp.take_along_axis(ci, sel, axis=1)
+
+    def full_path(_):
+        chunk = min(op.scan_chunk, Dl)
+        vals_l, ids_l = chunked_corpus_topk(qvec, dvec, dlive, k, chunk,
+                                            precision=prec)
+        ids_g = jnp.where(vals_l <= NEG, -1, ids_l + base_d)
+        # ring merge over ICI neighbors (ppermute): n-1 hops, each passing
+        # a [Q, k] candidate window and merging into the local best —
+        # peak buffer [Q, 2k] vs an all_gather's [Q, n*k]
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        acc_v, acc_i = vals_l, ids_g
+        cur_v, cur_i = vals_l, ids_g
+        for _ in range(n - 1):
+            cur_v = jax.lax.ppermute(cur_v, axis, perm)
+            cur_i = jax.lax.ppermute(cur_i, axis, perm)
+            acc_v, acc_i = _merge2(acc_v, acc_i, cur_v, cur_i)
+        return acc_v, acc_i
 
     def incr_path(_):
         em_ids = emitted[:, :, 0].astype(jnp.int32)

@@ -1,0 +1,68 @@
+"""Config/flag system (SURVEY.md §5): one dataclass, one env mapping.
+
+The load-bearing flag is the executor choice (cpu | tpu | sharded |
+staged — SURVEY.md §5 names it explicitly); the rest are the scheduler
+knobs every entry point was already threading by hand. ``from_env`` reads
+the ``REFLOW_*`` environment (the convention bench.py established), so a
+driver can flip the executor or loop bounds without code changes::
+
+    cfg = ReflowConfig.from_env()          # REFLOW_EXECUTOR=sharded ...
+    sched = cfg.scheduler(graph)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+__all__ = ["ReflowConfig"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ReflowConfig:
+    #: executor registry name: cpu (default path / oracle), tpu, sharded,
+    #: staged
+    executor: str = "cpu"
+    #: fixpoint pass bound per tick (DirtyScheduler.max_loop_iters)
+    max_loop_iters: int = 10_000
+    #: idempotent-push dedup horizon (batch ids remembered)
+    dedup_window: int = 1 << 20
+    #: mesh size for the sharded executor (None = all local devices)
+    mesh_devices: Optional[int] = None
+    #: disable the fused delta-vector loop (tpu/sharded executors)
+    linear_fixpoint: bool = True
+
+    @staticmethod
+    def from_env(env=os.environ) -> "ReflowConfig":
+        md = env.get("REFLOW_MESH_DEVICES")
+        return ReflowConfig(
+            executor=env.get("REFLOW_EXECUTOR", "cpu"),
+            max_loop_iters=int(env.get("REFLOW_MAX_LOOP_ITERS", 10_000)),
+            dedup_window=int(env.get("REFLOW_DEDUP_WINDOW", 1 << 20)),
+            mesh_devices=int(md) if md else None,
+            linear_fixpoint=env.get("REFLOW_LINEAR_FIXPOINT", "1") != "0",
+        )
+
+    def make_executor(self):
+        from reflow_tpu.executors import get_executor
+
+        if self.executor == "sharded":
+            from reflow_tpu.parallel import make_mesh
+            from reflow_tpu.parallel.shard import ShardedTpuExecutor
+
+            mesh = make_mesh(self.mesh_devices)
+            ex = ShardedTpuExecutor(mesh)
+        else:
+            ex = get_executor(self.executor)
+        if hasattr(ex, "linear_fixpoint") and not self.linear_fixpoint:
+            ex.linear_fixpoint = False
+            ex._linear_fixpoint = False
+        return ex
+
+    def scheduler(self, graph):
+        from reflow_tpu.scheduler import DirtyScheduler
+
+        return DirtyScheduler(graph, self.make_executor(),
+                              max_loop_iters=self.max_loop_iters,
+                              dedup_window=self.dedup_window)

@@ -250,3 +250,31 @@ def test_fault_injection_exactly_once():
     assert chan.stats["dropped"] > 0, "no faults were injected"
     assert chan.stats["duplicated"] > 0
     assert dict(clean.view(sink1.name)) == dict(faulty.view(sink2.name))
+
+
+def test_config_from_env_and_scheduler():
+    """SURVEY.md §5 config/flag system: the executor choice is the
+    load-bearing flag; env mapping builds a working scheduler."""
+    import numpy as np
+
+    from reflow_tpu import DeltaBatch, FlowGraph, Spec
+    from reflow_tpu.utils.config import ReflowConfig
+
+    cfg = ReflowConfig.from_env({"REFLOW_EXECUTOR": "tpu",
+                                 "REFLOW_MAX_LOOP_ITERS": "77",
+                                 "REFLOW_LINEAR_FIXPOINT": "0"})
+    assert cfg.executor == "tpu" and cfg.max_loop_iters == 77
+    g = FlowGraph()
+    src = g.source("s", Spec((), np.float32, key_space=8))
+    g.sink(g.reduce(src, "sum"), "out")
+    sched = cfg.scheduler(g)
+    assert sched.max_loop_iters == 77
+    assert sched.executor.name == "tpu"
+    assert not sched.executor._linear_fixpoint
+    sched.push(src, DeltaBatch(np.array([2]), np.array([5.0], np.float32)))
+    sched.tick()
+    assert sched.view_dict("out") == {2: 5.0}
+
+    sh = ReflowConfig.from_env({"REFLOW_EXECUTOR": "sharded",
+                                "REFLOW_MESH_DEVICES": "8"})
+    assert sh.make_executor().n == 8
