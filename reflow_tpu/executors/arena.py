@@ -15,12 +15,15 @@ the retract batch replays the original row with weight -1; float values
 are compared bitwise, so NaNs and signed zeros cancel only their
 bit-identical twins).
 
-The executor triggers compaction from its host-side high-water check
-(``_track_arena``): when planned appends would cross capacity, compact
-first, refresh the tracker from the true occupancy (one scalar readback),
-and only fail if the arena is genuinely full of live rows. Sharded
-executors run the same kernel per shard under ``shard_map`` (rows never
-migrate; each shard's occupancy counter is its slice of ``rcount``).
+Compaction triggers IN-PROGRAM: ``join_core`` wraps this kernel in a
+``lax.cond`` guarded by ``rcount + appends > capacity``, so the
+high-water decision is data-dependent on device and never reads a value
+back to the host (SURVEY.md §7 hard part d — streaming ticks stay
+pipelined). A genuine overflow (live + appends > capacity even after
+compaction) sets the join state's sticky ``error`` flag, raised at the
+next sync point. Sharded executors reach this through the same path:
+``join_core`` runs per shard under ``shard_map`` (rows never migrate;
+each shard compacts its slice and its slot of ``rcount``).
 """
 
 from __future__ import annotations

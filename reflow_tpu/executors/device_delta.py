@@ -67,6 +67,20 @@ class DeviceDelta(NamedTuple):
         )
 
 
+def check_weight_mass(batch: DeltaBatch) -> None:
+    """Reject batches the device path cannot fold exactly.
+
+    The device Reduce folds weights through a fused float32 scatter-add
+    (lowerings._scatter_contribs); a per-batch |w| mass beyond 2**24
+    would be silently inexact — fail loudly at the host boundary. Every
+    host->device ingestion path (to_device, the macro-tick stacker) must
+    call this."""
+    if len(batch) and int(np.abs(batch.weights).sum()) >= 1 << 24:
+        raise ValueError(
+            "batch weight mass >= 2**24 exceeds the device path's exact "
+            "float32 range; split the batch across ticks")
+
+
 def to_device(batch: DeltaBatch, spec: Spec,
               capacity: Optional[int] = None) -> DeviceDelta:
     """Host DeltaBatch -> padded DeviceDelta (the source host boundary)."""
@@ -74,13 +88,7 @@ def to_device(batch: DeltaBatch, spec: Spec,
     cap = capacity if capacity is not None else bucket_capacity(n)
     if n > cap:
         raise ValueError(f"batch of {n} rows exceeds capacity {cap}")
-    if n and int(np.abs(batch.weights).sum()) >= 1 << 24:
-        # device Reduce folds weights through a fused float32 scatter-add
-        # (lowerings._scatter_contribs); a per-batch |w| mass beyond 2**24
-        # would be silently inexact — fail loudly at the host boundary
-        raise ValueError(
-            "batch weight mass >= 2**24 exceeds the device path's exact "
-            "float32 range; split the batch across ticks")
+    check_weight_mass(batch)
     keys = np.zeros(cap, np.int32)
     weights = np.zeros(cap, np.int32)
     values = np.zeros((cap,) + tuple(spec.value_shape), spec.value_dtype)

@@ -169,7 +169,47 @@ def _emitted_diff(snap: Tuple[jax.Array, jax.Array], state: dict,
     )
 
 
-class FixpointProgram:
+def make_scan_program(tick_fn):
+    """K consecutive ticks fused into ONE device execution.
+
+    ``lax.scan`` over the tick program with the K per-tick ingress
+    pytrees stacked on a leading axis. Every execution over a
+    tunnel-attached device carries a large fixed overhead (measured
+    ~0.1-0.3s regardless of program size), so batching K ticks into one
+    program amortizes it K-fold — the "macro-tick" streaming fast path.
+    Sink-free graphs only (the caller guards): per-tick sink egress
+    would otherwise need stacking and per-tick host materialization.
+    """
+    import jax
+
+    def scan_fn(op_states, ing_stack):
+        def body(states, ing):
+            states2, sink_eg, iters, rows, conv = tick_fn(states, ing)
+            assert not sink_eg, "macro-tick requires a sink-free graph"
+            return states2, (iters, rows, conv)
+
+        states, ys = jax.lax.scan(body, op_states, ing_stack)
+        return states, ys
+
+    return jax.jit(scan_fn, donate_argnums=0)
+
+
+class _MacroTickMixin:
+    """Shared macro-tick entry for the two fixpoint program kinds: both
+    set ``self.tick_fn`` (the unjitted tick) in ``__init__``."""
+
+    def call_many(self, op_states, ing_stack, n_ticks: int):
+        """-> (states', (iters[K], rows[K], converged[K]))."""
+        cache = getattr(self, "_many_cache", None)
+        if cache is None:
+            cache = self._many_cache = {}
+        prog = cache.get(n_ticks)
+        if prog is None:
+            prog = cache[n_ticks] = make_scan_program(self.tick_fn)
+        return prog(op_states, ing_stack)
+
+
+class FixpointProgram(_MacroTickMixin):
     """One compiled tick: phase A pass + while_loop + exit pass.
 
     Built per (dirty-plan, ingress-capacity) signature and cached by the
@@ -267,6 +307,7 @@ class FixpointProgram:
 
         # donate the state pytree: ticks update arenas/tables in place
         # instead of copying them (the executor drops old refs on return)
+        self.tick_fn = tick_fn
         self._fn = jax.jit(tick_fn, donate_argnums=0)
 
     def __call__(self, op_states, dev_ingress):

@@ -60,7 +60,8 @@ import jax
 import jax.numpy as jnp
 
 from reflow_tpu.executors.device_delta import DeviceDelta
-from reflow_tpu.executors.fixpoint import FixpointStructure, _emitted_diff
+from reflow_tpu.executors.fixpoint import (FixpointStructure,
+                                           _MacroTickMixin, _emitted_diff)
 from reflow_tpu.executors.lowerings import (_agg_tables, _bcast_w, _differs,
                                             _masked_contrib)
 from reflow_tpu.graph import FlowGraph, Node
@@ -189,20 +190,25 @@ def _rowfn(fn: Callable, vectorized: bool) -> Callable:
 
 def _edge_budget_tiers(arena_capacity: int) -> List[int]:
     """Static gather budgets, large to small; the dense full-arena branch
-    sits above the largest. A budget pass costs ~2.5x more per row than a
-    dense sweep (compaction + ragged indirection), so budgets above
-    arena/8 never win — the largest tier starts there. Ratio-4 steps
-    bound wasted gather slots to 4x the live frontier while keeping the
-    lax.switch small."""
+    sits above the largest. The per-row bottleneck of BOTH branches is the
+    contribution scatter into the reduce table (measured on v5e: ~74M
+    rows/s scattered vs ~550M rows/s gathered), and the scatter scales
+    with the branch's row count — EB for a budget pass, the full arena for
+    the dense sweep. A budget pass adds ~3 extra gathers per row
+    (compaction + ragged expansion), so its cost is ~(3g+s)·EB vs
+    ~(g+s)·cap dense; with s≈7.4g a budget pass wins whenever
+    EB ≲ 0.8·cap. The largest tier therefore starts at arena/2 (safety
+    margin over the crossover). Ratio-4 steps bound wasted gather slots to
+    4x the live frontier while keeping the lax.switch small."""
     tiers = []
-    c = 1 << (max(arena_capacity // 8, 1).bit_length() - 1)
+    c = 1 << (max(arena_capacity // 2, 1).bit_length() - 1)
     while c >= 2048 and len(tiers) < 6:
         tiers.append(c)
         c //= 4
     return tiers
 
 
-class LinearFixpointProgram:
+class LinearFixpointProgram(_MacroTickMixin):
     """One compiled tick for a linear loop region: row-based phase A +
     fused delta-vector while_loop + row-based exit pass.
 
@@ -456,8 +462,6 @@ class LinearFixpointProgram:
             ]
             branches.append(lambda c: dense_body(c[0], arena, c[1], base))
             dense_ix = len(tiers)
-            # descending budgets; pick the smallest that fits
-            thresholds = jnp.asarray(tiers or [0], jnp.int32)
 
             def live(xw):
                 l = jnp.any(xw != 0)
@@ -481,7 +485,14 @@ class LinearFixpointProgram:
                         # so lax.switch branches (which contain psum_scatter)
                         # never diverge across devices
                         nedges = jax.lax.pmax(nedges, axis)
-                    n_fits = jnp.sum((thresholds >= nedges).astype(jnp.int32))
+                    # descending budgets; pick the smallest that fits.
+                    # Scalar compares over the static tier list — never a
+                    # materialized s32[k] literal: the remote-device runtime
+                    # drops into a degraded dispatch mode (~88ms/dispatch,
+                    # process-wide, permanent) after executing any program
+                    # whose HLO carries a multi-element constant.
+                    n_fits = sum(((jnp.int32(t) >= nedges).astype(jnp.int32)
+                                  for t in tiers), jnp.zeros((), jnp.int32))
                     ix = jnp.where(n_fits > 0, n_fits - 1, dense_ix)
                     rst2, xw2, prows = jax.lax.switch(ix, branches, (rst, xw))
                 else:
@@ -562,6 +573,7 @@ class LinearFixpointProgram:
 
         # donate the state pytree: the arena and dense tables update in
         # place instead of being copied every tick
+        self.tick_fn = tick_fn
         self._fn = jax.jit(tick_fn, donate_argnums=0)
 
     def __call__(self, op_states, dev_ingress):

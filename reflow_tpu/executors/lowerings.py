@@ -88,6 +88,9 @@ def join_state(op: Join, left_spec: Spec, right_spec: Spec) -> dict:
                            right_spec.value_dtype),
         "rw": jnp.zeros((R,), jnp.int32),
         "rcount": jnp.zeros((), jnp.int32),
+        # sticky: set when an append overflows the arena even after the
+        # in-program compaction pass (checked loudly at the next sync)
+        "error": jnp.zeros((), jnp.bool_),
     }
 
 
@@ -378,6 +381,7 @@ def join_core(op: Join, K: int, R: int, odtype, state,
         lval = lval.at[ins_keys].set(da.values, mode="drop")
 
     rkeys, rvals, rw, rcount = ak, av, aw, state["rcount"]
+    err = state.get("error", jnp.zeros((), jnp.bool_))
     if db is not None:
         # (A + δA) ⋈ δB
         kb, vb, wb = db.keys, db.values, db.weights
@@ -385,14 +389,29 @@ def join_core(op: Join, K: int, R: int, odtype, state,
         vals = merge_v(kb, lval[kb], vb)
         outs.append(DeviceDelta(kb + key_offset, vals, w))
 
-        # append δB to the arena (compacted: live rows first)
+        # append δB to the arena (compacted: live rows first). The
+        # high-water check is IN-PROGRAM: when the append would cross
+        # capacity, a lax.cond runs the compaction kernel (cancel matched
+        # insert/retract pairs) first — the decision never reads a device
+        # value back to the host, so streaming ticks stay pipelined
+        # (SURVEY.md §7 hard part d). A genuine overflow (live rows +
+        # appends > capacity even after compaction) drops the excess rows
+        # and sets the sticky error flag, raised at the next sync point.
+        from reflow_tpu.executors.arena import compact_arena
+
         liveb = wb != 0
+        n_app = jnp.sum(liveb.astype(jnp.int32))
+        arena = {"rkeys": ak, "rvals": av, "rw": aw,
+                 "rcount": state["rcount"]}
+        arena = jax.lax.cond(arena["rcount"] + n_app > R,
+                             compact_arena, lambda s: s, arena)
         rank = jnp.cumsum(liveb.astype(jnp.int32)) - 1
-        pos = jnp.where(liveb, state["rcount"] + rank, R)
-        rkeys = ak.at[pos].set(kb, mode="drop")
-        rvals = av.at[pos].set(vb, mode="drop")
-        rw = aw.at[pos].set(wb, mode="drop")
-        rcount = state["rcount"] + jnp.sum(liveb.astype(jnp.int32))
+        pos = jnp.where(liveb, arena["rcount"] + rank, R)
+        rkeys = arena["rkeys"].at[pos].set(kb, mode="drop")
+        rvals = arena["rvals"].at[pos].set(vb, mode="drop")
+        rw = arena["rw"].at[pos].set(wb, mode="drop")
+        rcount = arena["rcount"] + n_app
+        err = err | (rcount > R)
 
     out = DeviceDelta(
         jnp.concatenate([o.keys for o in outs]),
@@ -400,7 +419,7 @@ def join_core(op: Join, K: int, R: int, odtype, state,
         jnp.concatenate([o.weights for o in outs]),
     )
     new_state = {"lval": lval, "lw": lw, "rkeys": rkeys, "rvals": rvals,
-                 "rw": rw, "rcount": rcount}
+                 "rw": rw, "rcount": rcount, "error": err}
     return out, new_state
 
 

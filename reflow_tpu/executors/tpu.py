@@ -23,7 +23,8 @@ import jax
 from reflow_tpu.delta import DeltaBatch
 from reflow_tpu.executors.base import Executor
 from reflow_tpu.executors.device_delta import (DeviceDelta, bucket_capacity,
-                                               to_device, to_host)
+                                               check_weight_mass, to_device,
+                                               to_host)
 from reflow_tpu.executors.lowerings import (DEVICE_REDUCERS, join_state,
                                             lower_node, reduce_state)
 from reflow_tpu.graph import FlowGraph, GraphError, Node
@@ -37,7 +38,6 @@ class TpuExecutor(Executor):
     def __init__(self, *, fixpoint: bool = True, linear_fixpoint: bool = True):
         super().__init__()
         self._cache: Dict[tuple, object] = {}
-        self._arena_used: Dict[int, int] = {}  # join node id -> host upper bound
         #: lower whole ticks of iterative graphs to one lax.while_loop
         #: program (False forces the host-driven per-pass loop)
         self.fixpoint = fixpoint
@@ -69,7 +69,6 @@ class TpuExecutor(Executor):
             self._linear_fixpoint = self.linear_fixpoint
         self.graph = graph
         self.states = {}
-        self._arena_used.clear()
         for node in graph.nodes:
             if node.kind != "op":
                 continue
@@ -128,13 +127,8 @@ class TpuExecutor(Executor):
                         f"{node}: device Join requires an explicit "
                         f"vectorized merge(keys, va, vb) function")
                 self.states[node.id] = join_state(op, in_specs[0], in_specs[1])
-                self._arena_used[node.id] = 0
             else:
                 raise GraphError(f"{node}: no TPU lowering for {op.kind}")
-        if type(self) is TpuExecutor:
-            # subclasses re-shape join states after this bind and warm at
-            # the end of their own (see ShardedTpuExecutor.bind)
-            self.warm_gc()
 
     # -- one pass ----------------------------------------------------------
 
@@ -226,7 +220,12 @@ class TpuExecutor(Executor):
             converged = bool(converged)
             looped = iters > 0
         else:
-            passes = 1 + iters + exit_passes  # device scalar; no readback
+            # LazyScalar, not eager jnp arithmetic: a per-tick scalar op
+            # would dispatch an extra device execution (large fixed cost
+            # over a tunnel); int() combines at the sync point instead
+            from reflow_tpu.scheduler import LazyScalar
+
+            passes = LazyScalar(1 + exit_passes, iters)
             looped = True  # conservative dirty-set report
         # nodes the fused passes executed beyond the phase-A plan (for the
         # scheduler's dirty-set observability): region + exit nodes, which
@@ -235,6 +234,89 @@ class TpuExecutor(Executor):
                        if looped else set())
         return ({sid: list(batches) for sid, batches in sink_egress.items()},
                 passes, rows, converged, extra_dirty)
+
+    def run_tick_fixpoint_many(self, plan, feeds, max_iters):
+        """K consecutive ticks as ONE device execution (the macro-tick).
+
+        ``feeds`` is a list of K ``{node_id: DeltaBatch}`` ingress dicts
+        with identical node sets and identical padded capacities. Only
+        sink-free fused-fixpoint graphs qualify (sink egress would need
+        per-tick host materialization). Returns ``(iters[K], rows[K],
+        converged[K], extra_dirty)`` with the scalars device-resident
+        (zero readbacks — the streaming fast path), or None when the
+        graph/feeds don't fit (caller falls back to per-tick loop).
+
+        Why: every device execution over a tunnel carries a large fixed
+        overhead (~0.1-0.3s measured, independent of program size);
+        ``lax.scan``-ing K ticks into one execution amortizes it K-fold.
+        """
+        from reflow_tpu.executors.fixpoint import analyze
+
+        if self._fx_unsupported or self.graph.sinks:
+            return None
+        if self._fx_structure is None:
+            self._fx_structure = analyze(self.graph)
+            if self._fx_structure is None:
+                self._fx_unsupported = True
+                return None
+
+        K = len(feeds)
+        node_ids = sorted(feeds[0])
+        if any(sorted(f) != node_ids for f in feeds):
+            return None
+        # host-side stacking: ONE [K, C] transfer per ingress column
+        # instead of K separate uploads
+        import numpy as _np
+
+        import jax.numpy as _jnp
+
+        stack = {}
+        caps = {}
+        for nid in node_ids:
+            spec = self.graph.nodes[nid].spec
+            cap = max(bucket_capacity(len(f[nid])) for f in feeds)
+            caps[nid] = cap
+            keys = _np.zeros((K, cap), _np.int32)
+            weights = _np.zeros((K, cap), _np.int32)
+            values = _np.zeros((K, cap) + tuple(spec.value_shape),
+                               spec.value_dtype)
+            for t, f in enumerate(feeds):
+                b = f[nid]
+                check_weight_mass(b)   # same host-boundary guard as to_device
+                n = len(b)
+                if n:
+                    keys[t, :n] = b.keys.astype(_np.int64)
+                    weights[t, :n] = b.weights
+                    values[t, :n] = _np.asarray(b.values).reshape(
+                        (n,) + tuple(spec.value_shape))
+            stack[nid] = DeviceDelta(_jnp.asarray(keys),
+                                     _jnp.asarray(values),
+                                     _jnp.asarray(weights))
+
+        sig = ("fx", tuple(n.id for n in plan),
+               tuple(sorted(caps.items())), max_iters)
+        prog = self._cache.get(sig)
+        if prog is None:
+            prog = self._build_fixpoint(plan, caps, max_iters)
+            if prog is None:
+                return None
+            self._cache[sig] = prog
+        if not hasattr(prog, "call_many"):
+            return None
+
+        st = self._fx_structure
+        self._track_arena(plan, caps)
+        if st.exit_plan:
+            self._track_arena(
+                list(st.exit_plan),
+                {n.id: 2 * n.inputs[0].spec.key_space for n in st.boundary})
+
+        new_states, (iters, rows, conv) = prog.call_many(
+            dict(self.states), stack, K)
+        self.states = new_states
+        extra_dirty = set(st.region_ids) | {n.id for n in st.exit_plan}
+        passes_base = K * (1 + (1 if st.exit_plan else 0))
+        return passes_base, iters, rows, conv, extra_dirty
 
     def _build_fixpoint(self, plan, caps, max_iters):
         """Pick the fused delta-vector program when the region's operator
@@ -287,8 +369,16 @@ class TpuExecutor(Executor):
             "params": jax.tree.map(lambda x: jnp.array(x, copy=True), params)}
 
     def check_errors(self) -> None:
-        for nid, st in self.states.items():
-            if isinstance(st, dict) and "error" in st and bool(st["error"]):
+        # one batched device_get for all sticky flags: every join and
+        # min/max reducer carries an 'error' leaf, and per-leaf bool()
+        # round trips serialize (~0.1s each on a degraded tunnel)
+        flagged = [(nid, st["error"]) for nid, st in self.states.items()
+                   if isinstance(st, dict) and "error" in st]
+        if not flagged:
+            return
+        vals = jax.device_get([e for _, e in flagged])
+        for (nid, _), v in zip(flagged, vals):
+            if v:
                 node = self.graph.nodes[nid]
                 raise RuntimeError(f"{node}: {self._error_reason(node)}")
 
@@ -299,6 +389,13 @@ class TpuExecutor(Executor):
             return ("a retraction reached a device min/max reducer "
                     "(insert-only on device); this tick's state is invalid "
                     "— run retraction-bearing min/max on the CPU executor")
+        if node.kind == "op" and node.op.kind == "join":
+            return ("join sticky error: either the arena overflowed (live "
+                    "rows + appends exceeded capacity even after in-program "
+                    "compaction — raise arena_capacity) or, under a sharded "
+                    "executor, sparse routing overflowed its per-destination "
+                    "budget (key skew — raise delta capacity or rebalance "
+                    "the key space); this tick's state is invalid")
         return ("sticky device error flag set (sparse-route overflow: key "
                 "skew exceeded the ROUTE_SLACK per-destination budget); "
                 "this tick's state is invalid — raise the delta capacity "
@@ -333,10 +430,15 @@ class TpuExecutor(Executor):
         raise KeyError(f"{node} ({node.op.kind}) has no table to read")
 
     def _track_arena(self, plan, ingress_caps: Dict[int, int]):
-        """Host-side conservative overflow check for Join arenas.
+        """Static per-tick capacity sanity for Join arenas.
 
-        The append count is data-dependent (on device); we bound it by the
-        right input's capacity and fail loudly *before* silent truncation.
+        The *dynamic* high-water check lives inside the compiled tick
+        program: a ``lax.cond`` runs the compaction kernel when an append
+        would cross capacity, and a genuine overflow sets the join state's
+        sticky ``error`` flag (raised at the next sync point). No device
+        value is ever read back here — streaming ticks stay pipelined.
+        This host check only rejects the statically impossible case: one
+        tick's right-delta capacity exceeding the whole (per-shard) arena.
         ``ingress_caps`` maps seeded node ids (sources, loops, fixpoint
         boundary producers) to their delta capacities.
         """
@@ -351,18 +453,11 @@ class TpuExecutor(Executor):
                 continue
             if node.op.kind == "join":
                 cap = node.op.arena_capacity // self._arena_divisor
-                if self._arena_used[node.id] + caps[1] > cap:
-                    # high water: compact the arena (cancel matched
-                    # insert/retract pairs) and refresh the tracker from
-                    # true occupancy before deciding to fail
-                    self._arena_used[node.id] = self._compact_arena(node)
-                self._arena_used[node.id] += caps[1]
-                if self._arena_used[node.id] > cap:
+                if caps[1] > cap:
                     raise GraphError(
-                        f"{node}: join arena may overflow "
-                        f"({self._arena_used[node.id]} live+appended rows "
-                        f"vs per-shard capacity {cap}) even after "
-                        f"compaction; raise arena_capacity")
+                        f"{node}: a single tick's right-delta capacity "
+                        f"({caps[1]} rows) exceeds the per-shard arena "
+                        f"capacity {cap}; raise arena_capacity")
                 # an absent left delta skips the arena sweep entirely;
                 # sharded: each of the n shards emits 2*R/n + caps[1] rows
                 # (the right delta is all_gather'd), so global egress is
@@ -379,42 +474,6 @@ class TpuExecutor(Executor):
                 outs_cap[node.id] = sum(caps)
             else:
                 outs_cap[node.id] = caps[0]
-
-    def _gc_fn(self):
-        """The (cached) compiled arena-compaction kernel; sharded
-        subclasses wrap it per-shard."""
-        import jax
-
-        from reflow_tpu.executors.arena import compact_arena
-
-        fn = self._cache.get("gc")
-        if fn is None:
-            fn = jax.jit(compact_arena, donate_argnums=0)
-            self._cache["gc"] = fn
-        return fn
-
-    def warm_gc(self) -> None:
-        """Compile the arena-compaction kernel ahead of need by running it
-        on the (empty) bound arenas — semantically a no-op.
-
-        Root cause of VERDICT r2 weak #1 (streaming ticks "11x slower"):
-        the GC kernel's first-use compile (~45s over a remote-device
-        tunnel) landed inside the measured streaming window when the
-        high-water check first tripped. Called at the end of bind so the
-        compile is paid at construction, never mid-stream.
-        """
-        for node in self.graph.nodes:
-            if node.kind == "op" and node.op.kind == "join":
-                self.states[node.id] = self._gc_fn()(self.states[node.id])
-
-    def _compact_arena(self, node: Node) -> int:
-        """Compact one Join's arena in place; returns live-row occupancy
-        (per-shard max under sharding — the tracker's bound is
-        worst-case-skew per shard)."""
-        import numpy as np
-
-        self.states[node.id] = self._gc_fn()(self.states[node.id])
-        return int(np.max(np.asarray(self.states[node.id]["rcount"])))
 
     # -- trace & compile one pass program ----------------------------------
 

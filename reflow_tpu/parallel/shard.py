@@ -115,7 +115,6 @@ class ShardedTpuExecutor(TpuExecutor):
                 lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
                 st, specs[nid])
             for nid, st in self.states.items()}
-        self.warm_gc()
 
     def _state_spec(self, x) -> P:
         if getattr(x, "ndim", 0) >= 1 and x.shape[0] % self.n == 0:
@@ -146,25 +145,6 @@ class ShardedTpuExecutor(TpuExecutor):
     def update_params(self, node: Node, params) -> None:
         super().update_params(node, params)
         self.states[node.id] = replicate(self.states[node.id], self.mesh)
-
-    def _gc_fn(self):
-        """Per-shard arena compaction under shard_map: rows never migrate
-        between shards; each shard repacks its slice and its slot of the
-        rcount vector."""
-        import jax
-
-        from reflow_tpu.executors.arena import compact_arena
-
-        fn = self._cache.get("gc")
-        if fn is None:
-            def sharded_gc(state):
-                specs = jax.tree.map(self._state_spec, state)
-                return jax.shard_map(compact_arena, mesh=self.mesh,
-                                     in_specs=(specs,), out_specs=specs,
-                                     check_vma=False)(state)
-            fn = sharded_gc
-            self._cache["gc"] = fn
-        return fn
 
     # -- the SPMD pass program ---------------------------------------------
 

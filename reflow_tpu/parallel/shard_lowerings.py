@@ -283,7 +283,10 @@ def _lower_join_sharded(op, node: Node, state, ins, axis: str, n: int
     out, new_state = join_core(op, Kl, Rl, node.spec.value_dtype,
                                core_state, da_l, db_l, key_offset=base)
     new_state["rcount"] = new_state["rcount"][None]
-    new_state["error"] = err
+    # join_core's arena-overflow flag is per-shard; the state leaf is
+    # replicated, so fold it with pmax before OR-ing the route error in
+    new_state["error"] = err | (jax.lax.pmax(
+        new_state["error"].astype(jnp.int32), axis) > 0)
     return out, new_state
 
 

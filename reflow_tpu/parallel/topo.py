@@ -122,9 +122,6 @@ class StagedTpuExecutor(TpuExecutor):
             s = stage_of[node.id]
             if any(stage_of[c.id] > s for c, _ in graph.consumers(node)):
                 self._boundary_of[s].append(node.id)
-        # pre-compile the arena-GC kernel so a join's first high-water
-        # compaction never pays a compile mid-stream
-        self.warm_gc()
 
     # -- the staged pass ---------------------------------------------------
 

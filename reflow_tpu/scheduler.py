@@ -22,11 +22,53 @@ import time
 from collections import Counter, defaultdict
 from typing import Callable, Dict, List, Optional, Sequence
 
+import numpy as np
+
 from reflow_tpu.delta import DeltaBatch
 from reflow_tpu.executors import CpuExecutor, Executor
 from reflow_tpu.graph import FlowGraph, GraphError, Node
 
 __all__ = ["DirtyScheduler", "TickResult"]
+
+
+class LazyScalar:
+    """Deferred sum of host ints and device scalars.
+
+    Composing tick metadata (``1 + iters``, ``deltas_in + loop_rows``)
+    with eager jnp arithmetic would dispatch a device op per tick — and
+    on a tunnel-attached runtime every execution carries a large fixed
+    overhead, with scalar-chained ops the worst case. This keeps the
+    parts un-combined until ``int()`` forces them at the sync point."""
+
+    __slots__ = ("parts",)
+
+    def __init__(self, *parts):
+        self.parts = parts
+
+    def __int__(self) -> int:
+        def force(p):
+            if isinstance(p, int):
+                return p
+            if callable(p):
+                return int(p())
+            return int(np.asarray(p).sum())
+
+        return sum(force(p) for p in self.parts)
+
+    def __bool__(self) -> bool:
+        return int(self) != 0
+
+    def __add__(self, other):
+        return LazyScalar(*self.parts, other)
+
+    __radd__ = __add__
+
+
+def lazy_add(a, b):
+    """a + b without an eager device op when either side is device-resident."""
+    if isinstance(a, int) and isinstance(b, int):
+        return a + b
+    return LazyScalar(a, b)
 
 
 @dataclasses.dataclass
@@ -61,11 +103,18 @@ class TickResult:
     def block(self) -> "TickResult":
         """Force any device-resident scalar fields to host values and
         surface deferred executor errors (the streaming sync point; a
-        no-op for synchronous ticks)."""
-        self.passes = int(self.passes)
-        self.deltas_in = int(self.deltas_in)
-        self.deltas_out = int(self.deltas_out)
-        self.quiesced = bool(self.quiesced)
+        no-op for synchronous ticks). Macro-tick results (tick_many)
+        carry per-tick [K] stacks; they aggregate here."""
+        def to_int(x):
+            if isinstance(x, (int, LazyScalar)):
+                return int(x)
+            return int(np.asarray(x).sum())
+
+        self.passes = to_int(self.passes)
+        self.deltas_in = to_int(self.deltas_in)
+        self.deltas_out = to_int(self.deltas_out)
+        q = self.quiesced() if callable(self.quiesced) else self.quiesced
+        self.quiesced = bool(np.asarray(q).all())
         if self._check_errors is not None:
             check, self._check_errors = self._check_errors, None
             check()
@@ -111,8 +160,13 @@ class DirtyScheduler:
             self._seen_batch_ids[batch_id] = None
             while len(self._seen_batch_ids) > self.dedup_window:
                 self._seen_batch_ids.pop(next(iter(self._seen_batch_ids)))
-        if len(batch):
-            self._pending[source.id].append(batch)
+        # device-resident batches are enqueued unconditionally: their
+        # len() is a device->host readback (DeviceDelta.__len__), and any
+        # readback permanently degrades a tunnel-attached runtime's
+        # pipelining — a padded all-zero-weight batch is a cheap no-op
+        if not hasattr(batch, "nonzero") and not len(batch):
+            return True
+        self._pending[source.id].append(batch)
         return True
 
     # -- dirty planning (structural) --------------------------------------
@@ -140,12 +194,35 @@ class DirtyScheduler:
         scalars stay device-resident until ``block()``. Graphs with sinks
         or host-driven loops still materialize synchronously."""
         t0 = time.perf_counter()
+
+        def _merge_pending(batches):
+            # a device-resident batch passes through untouched (host
+            # concat would force readbacks); it cannot be merged with
+            # other same-tick batches for the same source
+            if any(hasattr(b, "nonzero") for b in batches):
+                if len(batches) > 1:
+                    raise GraphError(
+                        "a device-resident batch cannot be merged with "
+                        "other pending batches for the same source in "
+                        "one tick; push it alone")
+                return batches[0]
+            return DeltaBatch.concat(batches)
+
         ingress: Dict[int, DeltaBatch] = {
-            nid: DeltaBatch.concat(batches)
+            nid: _merge_pending(batches)
             for nid, batches in self._pending.items()
         }
         self._pending.clear()
-        deltas_in = sum(len(b) for b in ingress.values())
+        # device batches defer their live-row count entirely (len() or an
+        # eager nonzero() would read back / dispatch mid-tick);
+        # TickResult.block() counts them at the sync point
+        deltas_in = sum(len(b) for b in ingress.values()
+                        if not hasattr(b, "nonzero"))
+        dev_counts = [
+            (lambda w=b.weights: np.count_nonzero(np.asarray(w)))
+            for b in ingress.values() if hasattr(b, "nonzero")]
+        if dev_counts:
+            deltas_in = LazyScalar(deltas_in, *dev_counts)
         deltas_out = 0
         passes = 0
         dirty_union: set = set()
@@ -168,7 +245,7 @@ class DirtyScheduler:
                     (sink_batches, fx_passes, loop_rows, quiesced,
                      extra_dirty) = fx
                     passes = fx_passes
-                    deltas_in += loop_rows
+                    deltas_in = lazy_add(deltas_in, loop_rows)
                     dirty_union.update(extra_dirty)
                     for sid, batches in sink_batches.items():
                         sink_deltas[sink_ids[sid].name].extend(batches)
@@ -219,6 +296,87 @@ class DirtyScheduler:
             wall_s=time.perf_counter() - t0,
             quiesced=quiesced,
             _check_errors=None if checked else self.executor.check_errors,
+        )
+        self.history.append(result)
+        return result
+
+    def tick_many(self, feeds: Sequence[Dict[Node, DeltaBatch]]
+                  ) -> TickResult:
+        """K consecutive streaming ticks, fused into ONE device execution
+        when the executor supports it (the macro-tick; see
+        ``TpuExecutor.run_tick_fixpoint_many``). ``feeds[t]`` is tick
+        ``t``'s source-push set; semantics are identical to pushing and
+        ticking each feed in order with ``sync=False``.
+
+        Returns ONE aggregated TickResult covering all K ticks (scalar
+        fields sum/all-combine at ``block()``). Falls back to the
+        per-tick loop for executors/graphs without the fused path.
+        Requires no pending pushes (push() + tick_many don't mix) and a
+        sink-free graph on the fused path.
+        """
+        if any(self._pending.values()):
+            raise GraphError("tick_many cannot run with pending push()ed "
+                             "batches; tick() them first")
+        feeds = [{src.id: b for src, b in f.items()} for f in feeds]
+        for f in feeds:
+            for nid in f:
+                node = self.graph.nodes[nid]
+                if node.kind not in ("source", "loop"):
+                    raise GraphError(
+                        f"can only feed sources/loops, not {node}")
+
+        t0 = time.perf_counter()
+        runner = getattr(self.executor, "run_tick_fixpoint_many", None)
+        fx = None
+        plan = self._dirty_plan(sorted({n for f in feeds for n in f}))
+        if runner is not None and feeds:
+            fx = runner(plan, feeds, self.max_loop_iters)
+        if fx is None:
+            # fallback: ordinary streaming ticks, aggregated lazily (no
+            # readbacks here — everything combines at block(), keeping
+            # the deferred-sync contract even on the unfused path)
+            results = []
+            for f in feeds:
+                for nid, b in f.items():
+                    self._pending[nid].append(b)
+                results.append(self.tick(sync=False))
+            merged_sinks: Dict[str, List[DeltaBatch]] = defaultdict(list)
+            for r in results:
+                for name, b in r.sink_deltas.items():
+                    merged_sinks[name].append(b)
+            agg = TickResult(
+                tick=self._tick,
+                sink_deltas={name: DeltaBatch.concat(bs)
+                             for name, bs in merged_sinks.items()},
+                passes=LazyScalar(*[r.passes for r in results]),
+                dirty_nodes=max((r.dirty_nodes for r in results),
+                                default=0),
+                deltas_in=LazyScalar(*[r.deltas_in for r in results]),
+                deltas_out=LazyScalar(*[r.deltas_out for r in results]),
+                wall_s=time.perf_counter() - t0,
+                quiesced=(lambda rs=results: all(
+                    bool(np.asarray(r.quiesced).all()) for r in rs)),
+                _check_errors=self.executor.check_errors,
+            )
+            self.history.append(agg)
+            return agg
+
+        passes_base, iters, rows, conv, extra_dirty = fx
+        K = len(feeds)
+        host_rows = sum(len(b) for f in feeds for b in f.values())
+        plan_ids = {n.id for n in plan}
+        self._tick += K
+        result = TickResult(
+            tick=self._tick,
+            sink_deltas={},
+            # per-tick [K] stacks stay device-resident; block() aggregates
+            passes=LazyScalar(passes_base, iters),
+            dirty_nodes=len(plan_ids | extra_dirty),
+            deltas_in=LazyScalar(host_rows, rows),
+            deltas_out=0,
+            wall_s=time.perf_counter() - t0,
+            quiesced=conv,
+            _check_errors=self.executor.check_errors,
         )
         self.history.append(result)
         return result

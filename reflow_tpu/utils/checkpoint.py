@@ -96,15 +96,7 @@ def load_checkpoint(sched, path: str) -> None:
         for sid, st in restored.items():
             states[int(sid)] = st
     sched.executor.states = states
-    # the TPU executors' host-side join-arena overflow tracker was reset
-    # to 0 by bind(); reconstruct it from each restored arena's append
-    # counter so post-resume appends are still bounded against the true
-    # occupancy (ADVICE r1: without this, overflow silently truncates)
-    tracker = getattr(sched.executor, "_arena_used", None)
-    if tracker is not None:
-        import numpy as np
-
-        for nid in list(tracker):
-            st = states.get(nid)
-            if isinstance(st, dict) and "rcount" in st:
-                tracker[nid] = int(np.max(np.asarray(st["rcount"])))
+    # arena occupancy (rcount) and the sticky overflow flag travel inside
+    # the checkpointed state pytree itself; the in-program high-water
+    # check (lax.cond compaction in join_core) needs no host-side tracker
+    # reconstruction after restore

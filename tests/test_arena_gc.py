@@ -61,8 +61,8 @@ def test_long_churn_constant_arena(make_ex, arena_mult, ticks):
     for i in range(ticks):
         sched.push(pg.edges, web.churn(churn))
         assert sched.tick().quiesced, f"tick {i}"
-    # GC genuinely required: the tracker's conservative per-shard lifetime
-    # charge (bucketed ingress capacities) dwarfs the per-shard capacity
+    # GC genuinely required: the lifetime append mass (bucketed ingress
+    # capacities per tick) dwarfs the per-shard capacity
     assert bucket_capacity(E) + ticks * churn_cap > arena // arena_mult
     ref = pagerank.reference_ranks(web)
     ranks = sched.read_table(pg.new_rank)
@@ -107,3 +107,40 @@ def test_compact_arena_native_width_bit_identity():
         assert vals == [a, b]
     finally:
         jax.config.update("jax_enable_x64", False)
+
+
+def test_arena_overflow_sets_sticky_error():
+    """Genuine overflow — live rows + appends exceed capacity and nothing
+    cancels — must raise loudly at the next sync point via the join
+    state's sticky error flag (the in-program lax.cond compaction found
+    nothing to reclaim). The pre-round-3 host tracker raised *before*
+    dispatch but cost a device readback mid-stream; the sticky flag keeps
+    the failure loud without ever leaving the device mid-tick."""
+    from reflow_tpu import DeltaBatch, FlowGraph, Spec
+
+    K = 16
+    uniq = Spec((), np.float32, key_space=K, unique=True)
+    raw = Spec((), np.float32, key_space=K)
+    g = FlowGraph("overflow")
+    vals = g.source("vals", uniq)
+    edges = g.source("edges", raw)
+    tot = g.reduce(vals, "sum", name="uniq")
+    j = g.join(tot, edges, merge=lambda k, va, vb: va + vb, spec=raw,
+               arena_capacity=64, name="j")
+    out = g.reduce(j, "sum", name="joined")
+    g.sink(out, "out")
+
+    sched = DirtyScheduler(g, TpuExecutor())
+    sched.push(vals, DeltaBatch(np.arange(K, dtype=np.int64),
+                                np.ones(K, np.float32),
+                                np.ones(K, np.int64)))
+    sched.tick()
+
+    n, v0 = 48, 0
+    with pytest.raises(RuntimeError, match="arena overflowed"):
+        for _ in range(4):
+            keys = (np.arange(n) % K).astype(np.int64)
+            vals_b = np.arange(v0, v0 + n).astype(np.float32)  # all distinct
+            v0 += n
+            sched.push(edges, DeltaBatch(keys, vals_b, np.ones(n, np.int64)))
+            sched.tick()

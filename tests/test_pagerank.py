@@ -117,3 +117,71 @@ def test_pagerank_streaming_matches_synced():
     assert [r.passes for r in res_sync] == [r.passes for r in res_stream]
     assert ([r.deltas_in for r in res_sync]
             == [r.deltas_in for r in res_stream])
+
+
+def test_pagerank_macro_tick_matches_sequential():
+    """tick_many (K ticks lax.scan-fused into ONE device execution — the
+    tunnel-overhead amortization fast path) must produce bit-for-bit the
+    same state and the same aggregate tick metadata as K sequential
+    streaming ticks over the same churn sequence."""
+    web_a = pagerank.WebGraph.random(N, E, seed=13)
+    web_b = pagerank.WebGraph.random(N, E, seed=13)
+    K = 3
+
+    def prep(web):
+        pg = pagerank.build_graph(web.n_nodes, tol=TOL)
+        sched = DirtyScheduler(pg.graph, get_executor("tpu"),
+                               max_loop_iters=500)
+        sched.push(pg.teleport, pagerank.teleport_batch(web.n_nodes))
+        sched.push(pg.edges, web.initial_batch())
+        sched.tick()
+        return pg, sched, [web.churn(0.05) for _ in range(K)]
+
+    pg_a, sched_a, churns_a = prep(web_a)
+    results = []
+    for b in churns_a:
+        sched_a.push(pg_a.edges, b)
+        results.append(sched_a.tick(sync=False))
+    for r in results:
+        r.block()
+
+    pg_b, sched_b, churns_b = prep(web_b)
+    agg = sched_b.tick_many([{pg_b.edges: b} for b in churns_b]).block()
+
+    ranks_a = sched_a.read_table(pg_a.new_rank)
+    ranks_b = sched_b.read_table(pg_b.new_rank)
+    assert set(ranks_a) == set(ranks_b)
+    for k in ranks_a:
+        assert ranks_a[k] == ranks_b[k]
+    assert agg.quiesced
+    assert agg.passes == sum(r.passes for r in results)
+    assert agg.deltas_in == sum(r.deltas_in for r in results)
+    assert agg.tick == sched_a._tick
+
+
+def test_macro_tick_fallback_cpu_executor():
+    """tick_many on an executor without the fused path (the CPU oracle)
+    falls back to sequential ticks with identical semantics."""
+    web = pagerank.WebGraph.random(N, E, seed=17)
+    web2 = pagerank.WebGraph.random(N, E, seed=17)
+
+    def prep(web, name):
+        pg = pagerank.build_graph(web.n_nodes, tol=TOL)
+        sched = DirtyScheduler(pg.graph, get_executor(name),
+                               max_loop_iters=500)
+        sched.push(pg.teleport, pagerank.teleport_batch(web.n_nodes))
+        sched.push(pg.edges, web.initial_batch())
+        sched.tick()
+        return pg, sched
+
+    pg, sched = prep(web, "cpu")
+    churns = [web.churn(0.05) for _ in range(2)]
+    agg = sched.tick_many([{pg.edges: b} for b in churns]).block()
+    assert agg.quiesced
+
+    pg2, sched2 = prep(web2, "cpu")
+    for b in churns:
+        sched2.push(pg2.edges, b)
+        sched2.tick()
+    assert (sched.read_table(pg.new_rank)
+            == sched2.read_table(pg2.new_rank))
