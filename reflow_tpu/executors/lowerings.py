@@ -428,10 +428,14 @@ def join_core(op: Join, K: int, R: int, odtype, state,
 def knn_state(op, q_spec: Spec, d_spec: Spec) -> dict:
     Q, D = q_spec.key_space, d_spec.key_space
     dim, k = op.dim, op.k
+    # vectors store at the SOURCE spec dtype: bf16 embeddings halve both
+    # HBM residency and the host->device transfer per insert tick (the
+    # bandwidth-bound cost of config 4) at ~1e-3 relative score error —
+    # normalization and the scoring matmuls still accumulate in f32
     return {
-        "qvec": jnp.zeros((Q, dim), jnp.float32),
+        "qvec": jnp.zeros((Q, dim), q_spec.value_dtype),
         "qlive": jnp.zeros((Q,), jnp.bool_),
-        "dvec": jnp.zeros((D, dim), jnp.float32),
+        "dvec": jnp.zeros((D, dim), d_spec.value_dtype),
         "dlive": jnp.zeros((D,), jnp.bool_),
         "emitted": jnp.zeros((Q, k, 2), jnp.float32),
         "em_has": jnp.zeros((Q,), jnp.bool_),
@@ -450,8 +454,9 @@ def _fold_vectors(vec, live, delta):
     cap = vec.shape[0]
     ins = jnp.where(delta.weights > 0, delta.keys, cap)
     ret = jnp.where(delta.weights < 0, delta.keys, cap)
+    # normalize in f32 regardless of storage dtype, store at table dtype
     vals = _norm_rows(jnp.asarray(delta.values, jnp.float32))
-    vec = vec.at[ins].set(vals, mode="drop")
+    vec = vec.at[ins].set(jnp.asarray(vals, vec.dtype), mode="drop")
     live = live.at[ret].set(False, mode="drop").at[ins].set(True, mode="drop")
     return vec, live
 

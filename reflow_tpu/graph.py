@@ -171,8 +171,9 @@ class FlowGraph:
         return self.add_op(Union(arity=len(inputs)), list(inputs), name=name)
 
     def knn(self, queries: Node, docs: Node, k: int, dim: int, *,
-            name: Optional[str] = None, scan_chunk: int = 8192) -> Node:
-        op = KnnIndex(k, dim, scan_chunk=scan_chunk)
+            name: Optional[str] = None, scan_chunk: int = 8192,
+            precision: str = "highest") -> Node:
+        op = KnnIndex(k, dim, scan_chunk=scan_chunk, precision=precision)
         return self.add_op(op, [queries, docs], name=name)
 
     # -- structure queries -------------------------------------------------

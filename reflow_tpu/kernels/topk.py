@@ -15,12 +15,40 @@ and fold it into a running (values, ids) top-k carry.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 __all__ = ["topk", "chunked_corpus_topk", "NEG"]
+
+
+def _remote_tunnel_runtime() -> bool:
+    """True when the TPU sits behind the axon tunnel runtime (it
+    masquerades as platform "tpu"). Measured there: every execution of a
+    program containing a Pallas custom-call pays a multi-second fixed
+    penalty (~21s/exec at the k-NN bench shape vs ~0.05s device time),
+    so the XLA fallback wins by orders of magnitude despite the kernel
+    being faster on-chip. Override with REFLOW_TOPK_PALLAS=1/0.
+
+    Detection prefers axon's stable ``active_backend()`` accessor; the
+    env sentinel is the fallback (the plugin documents it as subject to
+    environ snapshot/restore)."""
+    try:
+        from axon.register import active_backend
+        return active_backend() is not None
+    except Exception:  # noqa: BLE001 - no axon installed / API drift
+        return os.environ.get("_AXON_REGISTERED") == "1"
+
+
+def _pallas_default() -> Optional[bool]:
+    env = os.environ.get("REFLOW_TOPK_PALLAS")
+    if env is not None:
+        return env == "1"
+    if _remote_tunnel_runtime():
+        return False
+    return None  # platform default: pallas on real TPU
 
 #: sentinel for "no candidate" — finite so arithmetic/compares stay clean
 NEG = float(jnp.finfo(jnp.float32).min)
@@ -81,7 +109,9 @@ def topk(scores: jax.Array, k: int,
     """
     on_tpu = jax.default_backend() == "tpu"
     if use_pallas is None:
-        use_pallas = on_tpu
+        use_pallas = _pallas_default()
+        if use_pallas is None:
+            use_pallas = on_tpu
     if use_pallas:
         return _topk_pallas(scores, k, interpret=not on_tpu)
     vals, idx = jax.lax.top_k(scores, k)

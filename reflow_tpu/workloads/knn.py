@@ -29,11 +29,19 @@ class KnnGraph:
 
 
 def build_graph(n_queries: int, n_docs: int, dim: int, k: int,
-                *, scan_chunk: int = 8192) -> KnnGraph:
+                *, scan_chunk: int = 8192, dtype=np.float32,
+                precision: str = "highest") -> KnnGraph:
+    """``dtype`` is the embedding storage/transfer dtype. ``bfloat16``
+    halves corpus HBM residency and the per-tick host->device upload
+    (the bandwidth-bound cost of streaming inserts) at ~1e-3 relative
+    score error — scoring still accumulates in float32 on the MXU; pair
+    it with ``precision="default"`` so the MXU takes bf16 inputs
+    natively instead of upcasting."""
     g = FlowGraph("knn")
-    q = g.source("queries", Spec((dim,), np.float32, key_space=n_queries))
-    d = g.source("docs", Spec((dim,), np.float32, key_space=n_docs))
-    idx = g.knn(q, d, k, dim, name="index", scan_chunk=scan_chunk)
+    q = g.source("queries", Spec((dim,), dtype, key_space=n_queries))
+    d = g.source("docs", Spec((dim,), dtype, key_space=n_docs))
+    idx = g.knn(q, d, k, dim, name="index", scan_chunk=scan_chunk,
+                precision=precision)
     return KnnGraph(g, q, d, idx)
 
 

@@ -119,3 +119,30 @@ def test_sharded_knn_matches_single_device():
     for q in range(Q):
         np.testing.assert_array_equal(
             np.asarray(t_sh[q])[:, 0].astype(np.int64), ref_ids[q])
+
+
+def test_bf16_embeddings_high_recall():
+    """bf16 embedding storage (halved HBM + halved per-tick upload, the
+    bandwidth-bound cost of config 4) must keep near-perfect recall vs
+    the f32 brute-force oracle — scoring still accumulates in f32."""
+    import jax.numpy as jnp
+
+    kg = knn.build_graph(Q, D, DIM, K, scan_chunk=D,
+                         dtype=jnp.bfloat16, precision="default")
+    sched = DirtyScheduler(kg.graph, get_executor("tpu"))
+    store = knn.EmbeddingStore.create(DIM, seed=3)
+    rng = np.random.default_rng(103)
+    qvecs = rng.normal(size=(Q, DIM)).astype(np.float32)
+    sched.push(kg.queries, DeltaBatch(np.arange(Q), qvecs))
+    sched.push(kg.docs, store.insert_batch(np.arange(0, 64)))
+    sched.tick()
+    sched.push(kg.docs, store.insert_batch(np.arange(64, 160)))
+    sched.tick()
+
+    ref_ids, ref_s = store.reference_topk(qvecs, K)
+    table = _ids_table(sched, kg)
+    hits = total = 0
+    for q in range(Q):
+        hits += len(set(table[q]) & set(ref_ids[q]))
+        total += K
+    assert hits / total >= 0.95, f"bf16 recall {hits/total:.3f}"
