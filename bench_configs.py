@@ -173,7 +173,9 @@ def cfg2_tfidf(smoke: bool, log) -> None:
     # 2^20-term vocabulary (a real Wikipedia-scale vocab is ~10^6; the
     # radix-split presence path is exact to 2^24 — workloads/tfidf.py)
     n_terms = 1 << (10 if smoke else 20)
-    n_pairs = 1 << (12 if smoke else 18)
+    # pair capacity covers the full run: initial corpus + per-edit AND
+    # micro-batched phases (each edit interns ~45 fresh (doc,term) pairs)
+    n_pairs = 1 << (13 if smoke else 19)
     edits = 32 if smoke else 512
     vocab = 1_000 if smoke else 250_000  # drawn words (ids intern densely)
     # np array, not list: rng.choice over a list re-converts all 250k
@@ -229,21 +231,31 @@ def cfg2_tfidf(smoke: bool, log) -> None:
                     "tick_ms_median": round(1e3 * float(np.median(walls)), 2),
                 })
             else:
-                # device path: zero readbacks before the measurement
-                # window (see _sync_read), then one pipelined window over
-                # all edits with a single completion barrier at the end
-                _push_edit(corpus.edit(0, text()))  # warm the churn shape
-                sched.tick(sync=False)
-                _settle(0 if smoke else 15, log,
-                        "drain tfidf initial load before window")
+                # device path: ALL edits of a window scan-fuse into ONE
+                # device execution (tick_many on the loop-free graph),
+                # amortizing the tunnel's per-execution overhead across
+                # the whole window; zero readbacks before the barrier
                 pads = []
 
-                def feed(i):
+                def make_feed():
                     d = int(rng.integers(0, n_docs))
-                    pads.append(_push_edit(corpus.edit(d, text())))
+                    b = corpus.edit(d, text())
+                    pads.append(max(0, edit_rows - len(b)))
+                    return {tg.tokens: _pad_batch(b, edit_rows)}
 
-                wall, dwall, results = _stream_window(sched, feed, edits)
-                dops = sum(r.delta_ops for r in results) - sum(pads)
+                sched.tick_many([make_feed() for _ in range(edits)])  # warm
+                pads.clear()
+                _settle(0 if smoke else 15, log,
+                        "drain tfidf initial load + warm window")
+                feeds = [make_feed() for _ in range(edits)]
+                t0 = time.perf_counter()
+                agg = sched.tick_many(feeds)
+                dwall = time.perf_counter() - t0
+                _sync_read(sched.executor)
+                wall = time.perf_counter() - t0
+                sched.executor.check_errors()
+                agg.block()
+                dops = agg.delta_ops - sum(pads)
                 _record(log, f"2_tfidf_{ex_name}", {
                     "executor": ex_name,
                     "docs": n_docs, "terms": n_terms,
@@ -251,6 +263,47 @@ def cfg2_tfidf(smoke: bool, log) -> None:
                     "delta_ops_per_s": round(dops / wall),
                     "tick_ms_amortized": round(1e3 * wall / edits, 2),
                     "dispatch_ms_total": round(1e3 * dwall, 1),
+                })
+
+                # micro-batched streaming: a realistic ingestion buffer
+                # groups edits per tick — a 256-row single edit cannot
+                # fill the chip, a few-thousand-row micro-batch can
+                group = 8 if smoke else 64
+                ticks2 = 4 if smoke else 32
+                # one bucket above any group's worst case (~80 rows/edit:
+                # retract+insert per touched term), so every window tick
+                # pads to ONE capacity and the measured window can never
+                # compile a fresh scan program mid-measurement
+                cap2 = 1024 if smoke else 8192
+                pads2 = []
+
+                def make_group():
+                    bs = []
+                    for _ in range(group):
+                        d = int(rng.integers(0, n_docs))
+                        bs.append(corpus.edit(d, text()))
+                    b = DeltaBatch.concat(bs)
+                    pads2.append(max(0, cap2 - len(b)))
+                    return {tg.tokens: _pad_batch(b, cap2)}
+
+                sched.tick_many([make_group() for _ in range(ticks2)])
+                pads2.clear()
+                _settle(0 if smoke else 10, log, "drain batched warm")
+                feeds2 = [make_group() for _ in range(ticks2)]
+                t0 = time.perf_counter()
+                agg2 = sched.tick_many(feeds2)
+                _sync_read(sched.executor)
+                wall2 = time.perf_counter() - t0
+                sched.executor.check_errors()
+                agg2.block()
+                dops2 = agg2.delta_ops - sum(pads2)
+                _record(log, "2_tfidf_tpu_batched", {
+                    "executor": ex_name,
+                    "docs": n_docs, "terms": n_terms,
+                    "edits_per_tick": group, "ticks": ticks2,
+                    "delta_ops_per_s": round(dops2 / wall2),
+                    "edits_per_s": round(group * ticks2 / wall2, 1),
+                    "tick_ms_amortized": round(1e3 * wall2 / ticks2, 2),
                 })
         run()
 

@@ -270,12 +270,12 @@ def _lower_reduce(op: Reduce, node: Node, state, ins) -> Tuple[DeviceDelta, dict
     C = d.capacity
     vdtype = node.spec.value_dtype
 
-    dws, dwc = _scatter_contribs(d, K)
-    wsum = state["wsum"] + dws
-    wcnt = state["wcnt"] + dwc
     emitted, em_has = state["emitted"], state["emitted_has"]
 
     if C >= K:
+        dws, dwc = _scatter_contribs(d, K)
+        wsum = state["wsum"] + dws
+        wcnt = state["wcnt"] + dwc
         # dense mode: diff the whole aggregate table against what was
         # emitted — no sort, pure vector ops (the PageRank-iteration shape).
         agg, exists = _agg_tables(op, wsum, wcnt, vdtype)
@@ -293,7 +293,17 @@ def _lower_reduce(op: Reduce, node: Node, state, ins) -> Tuple[DeviceDelta, dict
         new_emitted = jnp.where(ins_b, agg, emitted)
         new_has = jnp.where(ins_m, True, jnp.where(ret_m & ~exists, False, em_has))
     else:
-        # sparse mode: sort the touched keys, emit per first occurrence.
+        # sparse mode: O(C) end to end, never O(K) — contributions
+        # scatter-add straight into the persistent tables (no zeros[K]
+        # staging table, no full-table add), and aggregation/emission
+        # runs only on the gathered touched rows. This is what makes
+        # small-edit streaming (config 2: 256-row edits into 2^20-key
+        # tables) cost per-edit work instead of per-vocabulary work.
+        contrib = _masked_contrib(d.weights, d.values).astype(jnp.float32)
+        wsum = state["wsum"].at[d.keys].add(
+            contrib.astype(state["wsum"].dtype))
+        wcnt = state["wcnt"].at[d.keys].add(d.weights)
+
         live = d.weights != 0
         skey = jnp.where(live, d.keys, K)
         order = jnp.argsort(skey)
@@ -302,9 +312,7 @@ def _lower_reduce(op: Reduce, node: Node, state, ins) -> Tuple[DeviceDelta, dict
         first = (sk != prev) & (sk < K)
         tk = jnp.where(sk < K, sk, 0).astype(jnp.int32)
 
-        agg_tab, exists_tab = _agg_tables(op, wsum, wcnt, vdtype)
-        agg = agg_tab[tk]
-        exists = exists_tab[tk]
+        agg, exists = _agg_tables(op, wsum[tk], wcnt[tk], vdtype)
         em = emitted[tk]
         has = em_has[tk]
         changed = _differs(agg, em, op.tol)

@@ -240,11 +240,13 @@ class TpuExecutor(Executor):
 
         ``feeds`` is a list of K ``{node_id: DeltaBatch}`` ingress dicts
         with identical node sets and identical padded capacities. Only
-        sink-free fused-fixpoint graphs qualify (sink egress would need
-        per-tick host materialization). Returns ``(iters[K], rows[K],
-        converged[K], extra_dirty)`` with the scalars device-resident
-        (zero readbacks — the streaming fast path), or None when the
-        graph/feeds don't fit (caller falls back to per-tick loop).
+        sink-free graphs qualify (sink egress would need per-tick host
+        materialization): iterative graphs scan the fused fixpoint
+        program, loop-free graphs scan the plain pass program. Returns
+        ``(passes_base, iters, rows, converged, extra_dirty)`` with any
+        per-tick scalars device-resident (zero readbacks — the streaming
+        fast path), or None when the graph/feeds don't fit (caller falls
+        back to the per-tick loop).
 
         Why: every device execution over a tunnel carries a large fixed
         overhead (~0.1-0.3s measured, independent of program size);
@@ -252,7 +254,44 @@ class TpuExecutor(Executor):
         """
         from reflow_tpu.executors.fixpoint import analyze
 
-        if self._fx_unsupported or self.graph.sinks:
+        # fixpoint=False is the whole-tick-fusion opt-out (and what the
+        # staged executor, whose states are pinned per stage device,
+        # relies on to keep tick_many on the per-tick fallback)
+        if not self.fixpoint or self.graph.sinks:
+            return None
+        K = len(feeds)
+        node_ids = sorted(feeds[0])
+        if any(sorted(f) != node_ids for f in feeds):
+            return None
+
+        if not self.graph.loops:
+            # loop-free sink-free graph (e.g. streaming TF-IDF): scan the
+            # PLAIN pass program over the K stacked feeds — one device
+            # execution for K ticks, zero per-tick egress by construction
+            stack, caps = self._stack_feeds(feeds)
+            sig = ("pass_many", tuple(n.id for n in plan),
+                   tuple(sorted(caps.items())))
+            prog = self._cache.get(sig)
+            if prog is None:
+                pass_fn = self.build_pass_fn(list(plan))
+
+                def scan_fn(op_states, ing_stack):
+                    def body(states, ing):
+                        states2, egress = pass_fn(states, ing)
+                        assert not egress, ("loop-free sink-free pass "
+                                            "produced egress")
+                        return states2, ()
+
+                    states, _ = jax.lax.scan(body, op_states, ing_stack)
+                    return states
+
+                prog = jax.jit(scan_fn, donate_argnums=0)
+                self._cache[sig] = prog
+            self._track_arena(plan, caps)
+            self.states = prog(dict(self.states), stack)
+            return K, 0, 0, True, set()
+
+        if self._fx_unsupported:
             return None
         if self._fx_structure is None:
             self._fx_structure = analyze(self.graph)
@@ -260,39 +299,7 @@ class TpuExecutor(Executor):
                 self._fx_unsupported = True
                 return None
 
-        K = len(feeds)
-        node_ids = sorted(feeds[0])
-        if any(sorted(f) != node_ids for f in feeds):
-            return None
-        # host-side stacking: ONE [K, C] transfer per ingress column
-        # instead of K separate uploads
-        import numpy as _np
-
-        import jax.numpy as _jnp
-
-        stack = {}
-        caps = {}
-        for nid in node_ids:
-            spec = self.graph.nodes[nid].spec
-            cap = max(bucket_capacity(len(f[nid])) for f in feeds)
-            caps[nid] = cap
-            keys = _np.zeros((K, cap), _np.int32)
-            weights = _np.zeros((K, cap), _np.int32)
-            values = _np.zeros((K, cap) + tuple(spec.value_shape),
-                               spec.value_dtype)
-            for t, f in enumerate(feeds):
-                b = f[nid]
-                check_weight_mass(b)   # same host-boundary guard as to_device
-                n = len(b)
-                if n:
-                    keys[t, :n] = b.keys.astype(_np.int64)
-                    weights[t, :n] = b.weights
-                    values[t, :n] = _np.asarray(b.values).reshape(
-                        (n,) + tuple(spec.value_shape))
-            stack[nid] = DeviceDelta(_jnp.asarray(keys),
-                                     _jnp.asarray(values),
-                                     _jnp.asarray(weights))
-
+        stack, caps = self._stack_feeds(feeds)
         sig = ("fx", tuple(n.id for n in plan),
                tuple(sorted(caps.items())), max_iters)
         prog = self._cache.get(sig)
@@ -317,6 +324,38 @@ class TpuExecutor(Executor):
         extra_dirty = set(st.region_ids) | {n.id for n in st.exit_plan}
         passes_base = K * (1 + (1 if st.exit_plan else 0))
         return passes_base, iters, rows, conv, extra_dirty
+
+    def _stack_feeds(self, feeds):
+        """Host-side [K, C] stacking of K per-tick ingress dicts: ONE
+        transfer per ingress column instead of K separate uploads."""
+        import numpy as _np
+
+        import jax.numpy as _jnp
+
+        K = len(feeds)
+        stack = {}
+        caps = {}
+        for nid in sorted(feeds[0]):
+            spec = self.graph.nodes[nid].spec
+            cap = max(bucket_capacity(len(f[nid])) for f in feeds)
+            caps[nid] = cap
+            keys = _np.zeros((K, cap), _np.int32)
+            weights = _np.zeros((K, cap), _np.int32)
+            values = _np.zeros((K, cap) + tuple(spec.value_shape),
+                               spec.value_dtype)
+            for t, f in enumerate(feeds):
+                b = f[nid]
+                check_weight_mass(b)   # same host-boundary guard as to_device
+                n = len(b)
+                if n:
+                    keys[t, :n] = b.keys.astype(_np.int64)
+                    weights[t, :n] = b.weights
+                    values[t, :n] = _np.asarray(b.values).reshape(
+                        (n,) + tuple(spec.value_shape))
+            stack[nid] = DeviceDelta(_jnp.asarray(keys),
+                                     _jnp.asarray(values),
+                                     _jnp.asarray(weights))
+        return stack, caps
 
     def _build_fixpoint(self, plan, caps, max_iters):
         """Pick the fused delta-vector program when the region's operator
