@@ -169,12 +169,27 @@ def run_pagerank_tpu_child() -> dict:
     # timeslices long executions (~2-3x intra-execution stretch, high
     # variance), so the per-tick streaming window below measures better
     # and is the headline path.
+    #
+    # THREE windows, median throughput: the shared tunnel shows rare
+    # far-outlier windows (one recorded 8x the steady wall); the median
+    # outvotes them. Window 1 runs clean; its closing barrier degrades
+    # the tunnel, so windows 2-3 run ~10% slower — i.e. the median is
+    # conservative, never flattered.
     n = p["stream_ticks"]
     from bench_configs import _stream_window
-    wall, dwall, results = _stream_window(
-        sched, lambda i: sched.push(pr.edges, web.churn(p["churn"])), n)
-    assert all(r.quiesced for r in results)
-    dops = sum(r.delta_ops for r in results)
+    windows = []
+    for w_ix in range(3):
+        wall, dwall, results = _stream_window(
+            sched, lambda i: sched.push(pr.edges, web.churn(p["churn"])), n)
+        assert all(r.quiesced for r in results)
+        dops = sum(r.delta_ops for r in results)
+        windows.append({"wall_s": round(wall, 3),
+                        "dispatch_s": round(dwall, 3),
+                        "delta_ops": dops})
+        log(f"window {w_ix}: {wall:.2f}s for {n} ticks "
+            f"({dops / wall:,.0f} delta-ops/s)")
+    med = sorted(windows, key=lambda w: w["delta_ops"] / w["wall_s"])[1]
+    wall, dwall, dops = med["wall_s"], med["dispatch_s"], med["delta_ops"]
 
     # post-window extras (tunnel now degraded — every sync pays ~0.1s, so
     # these are conservative upper bounds, never enqueue times)
@@ -194,6 +209,7 @@ def run_pagerank_tpu_child() -> dict:
         "window_ticks": n,
         "window_wall_s": round(wall, 3),
         "window_dispatch_s": round(dwall, 3),
+        "windows": windows,
         "tick_s_amortized": round(wall / n, 4),
         "delta_ops_per_s": round(dops / wall),
         "delta_ops_per_tick": round(dops / n),
@@ -202,9 +218,9 @@ def run_pagerank_tpu_child() -> dict:
 
 
 def run_pagerank_full_child() -> dict:
-    """Child process: warm full-recompute baseline. Own process so its
-    single tick's closing readback is the first of the process (clean
-    pipelined dispatch, no degraded-mode overhead in the wall)."""
+    """Child process: warm full-recompute baseline. Own process so the
+    first measured round's closing readback is the first of the process
+    (clean pipelined dispatch); see the min-of-3 rationale below."""
     from bench_configs import _sync_read
     from reflow_tpu.executors import get_executor
     from reflow_tpu.scheduler import DirtyScheduler
@@ -219,18 +235,32 @@ def run_pagerank_full_child() -> dict:
     sched.push(pr.edges, web.initial_batch())
     sched.tick(sync=False)   # absorb the compile; leaves cache warm
 
-    # fresh states over the same graph: bind() resets state, keeps cache
-    sched2 = DirtyScheduler(pr.graph, ex)
-    sched2.push(pr.teleport, pagerank.teleport_batch(p["n_nodes"]))
-    sched2.push(pr.edges, web.initial_batch())
+    # fresh states over the same graph each round: bind() resets state,
+    # keeps the compiled-program cache. Three measurements, MINIMUM wall:
+    # full_recompute_s is the NUMERATOR of incr_vs_full, so the outlier
+    # guard must never inflate it — round 0 is clean (its barrier is the
+    # process's first readback), rounds 1-2 run tunnel-degraded and can
+    # only be slower; min() therefore both rejects a round-0 outlier and
+    # keeps the derived speedup conservative. (The churn windows use
+    # median-of-3 THROUGHPUT instead — there slow outliers deflate the
+    # headline, the opposite direction.)
     from bench_configs import _settle
-    _settle(0 if p["smoke"] else 15, log,
-            "drain the absorption tick before timing the full recompute")
-    t0 = time.perf_counter()
-    sched2.tick(sync=False)
-    _sync_read(ex)           # first readback of the process
-    full_s = time.perf_counter() - t0
-    return {"executor": "tpu", "full_recompute_s": round(full_s, 3)}
+    walls = []
+    for ix in range(3):
+        sched2 = DirtyScheduler(pr.graph, ex)
+        sched2.push(pr.teleport, pagerank.teleport_batch(p["n_nodes"]))
+        sched2.push(pr.edges, web.initial_batch())
+        if ix == 0:
+            _settle(0 if p["smoke"] else 15, log,
+                    "drain the absorption tick before timing full recompute")
+        t0 = time.perf_counter()
+        sched2.tick(sync=False)
+        _sync_read(ex)       # round 0: first readback of the process
+        walls.append(time.perf_counter() - t0)
+        log(f"full recompute {ix}: {walls[-1]:.2f}s")
+    return {"executor": "tpu",
+            "full_recompute_s": round(min(walls), 3),
+            "full_recompute_walls_s": [round(w, 2) for w in walls]}
 
 
 # -- subprocess orchestration ----------------------------------------------

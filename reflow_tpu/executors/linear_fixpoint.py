@@ -190,21 +190,21 @@ def _rowfn(fn: Callable, vectorized: bool) -> Callable:
 
 def _edge_budget_tiers(arena_capacity: int) -> List[int]:
     """Static gather budgets, large to small; the dense full-arena branch
-    sits above the largest. The per-row bottleneck of BOTH branches is the
-    contribution scatter into the reduce table (measured on v5e: ~74M
-    rows/s scattered vs ~550M rows/s gathered), and the scatter scales
-    with the branch's row count — EB for a budget pass, the full arena for
-    the dense sweep. A budget pass adds ~3 extra gathers per row
-    (compaction + ragged expansion), so its cost is ~(3g+s)·EB vs
-    ~(g+s)·cap dense; with s≈7.4g a budget pass wins whenever
-    EB ≲ 0.8·cap. The largest tier therefore starts at arena/2 (safety
-    margin over the crossover). Ratio-4 steps bound wasted gather slots to
-    4x the live frontier while keeping the lax.switch small."""
+    sits above the largest. Measured regime (v5e, 1.31M-row arena): the
+    contribution scatter (~74M rows/s) dominates both branches and scales
+    with the branch's row count, and the budget pass's frontier-table
+    gather-expand costs ~22ns/row of HBM traffic — a budget pass runs at
+    ~40ns/row total vs the dense sweep's ~17.5ns/row over the FULL arena.
+    Crossover is therefore near arena/2, where a budget pass only ties
+    the dense sweep (measured: 25ms vs 23ms) — so the ladder starts at
+    arena/4 (clear win, ~11ms) and steps by ratio 2, bounding wasted
+    gather slots to 2x the live frontier. Six tiers keep the lax.switch
+    small; frontiers below the floor ride the smallest tier cheaply."""
     tiers = []
-    c = 1 << (max(arena_capacity // 2, 1).bit_length() - 1)
+    c = 1 << (max(arena_capacity // 4, 1).bit_length() - 1)
     while c >= 2048 and len(tiers) < 6:
         tiers.append(c)
-        c //= 4
+        c //= 2
     return tiers
 
 
@@ -439,7 +439,13 @@ class LinearFixpointProgram(_MacroTickMixin):
                 axis=1)
 
             # per-tick CSR over the live arena slice (static in the loop;
-            # arena keys are local under sharding — see join routing)
+            # arena keys are local under sharding — see join routing).
+            # Rebuilt from scratch each tick (~31ms device at 1.31M rows)
+            # deliberately: maintaining it incrementally would either
+            # rewrite the full sorted table per tick (same cost as the
+            # rebuild) or carry a fresh-rows tail swept densely by every
+            # pass, which at 1% churn x ~13 passes costs what the rebuild
+            # does — measured wash, so the simple form stays
             rk, rv, rw = jstate["rkeys"], jstate["rvals"], jstate["rw"]
             Rcap = rk.shape[0]
             skey = jnp.where(rw != 0, rk, Klc)
