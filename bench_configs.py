@@ -325,11 +325,14 @@ def cfg4_knn(smoke: bool, log) -> None:
         else:
             Q, D, dim, k, chunk = 256, 1 << 20, 768, 16, 8192
             per_tick = 8192
-            # the BASELINE scale is a 1Mx768 corpus; uploading 3GB of
+            # the BASELINE scale is a 1Mx768 corpus; uploading the
             # embeddings through the source boundary costs real minutes
-            # over a tunnel, so the preload is env-tunable
+            # over a tunnel, so the preload is env-tunable. Default
+            # leaves headroom for every measured insert tick (absorb +
+            # 3 windows x 6 x per_tick) so the id wrap below never turns
+            # a measured insert into an in-place update (updates rescan)
             preload = int(os.environ.get("REFLOW_BENCH_KNN_PRELOAD",
-                                         (1 << 20) - 10 * 8192))
+                                         (1 << 20) - 24 * 8192))
 
         # bf16 embeddings + native-bf16 MXU scoring: halves the corpus
         # HBM residency AND the per-insert-tick host upload (the
@@ -348,7 +351,11 @@ def cfg4_knn(smoke: bool, log) -> None:
 
         def insert(n):
             nonlocal next_id
-            ids = np.arange(next_id, next_id + n)
+            # wrap into the corpus key space: once the id range is
+            # exhausted, inserts become embedding UPDATES of existing
+            # ids (the steady re-index regime) instead of out-of-range
+            # keys the device would silently drop
+            ids = np.arange(next_id, next_id + n) % D
             next_id += n
             return store.insert_batch(ids)
 
@@ -369,10 +376,19 @@ def cfg4_knn(smoke: bool, log) -> None:
             "REFLOW_BENCH_KNN_SETTLE", 150)), log,
             "drain the ~1M-row corpus preload before the insert window")
 
-        # insert-heavy re-index flow: one pipelined window, one barrier
-        wall, dwall, results = _stream_window(
-            sched, lambda i: sched.push(kg.docs, insert(per_tick)), 6)
-        dops = sum(r.delta_ops for r in results)
+        # insert-heavy re-index flow: THREE pipelined windows, median
+        # throughput — the tunnel shows far-outlier windows (recorded
+        # spread 0.7s..21s per tick for the identical program), and
+        # post-first-barrier windows run chained at true device speed
+        # (the pipelined mode's intra-execution stretch disappears);
+        # every window is a genuine completion-time wall either way
+        windows = []
+        for w_ix in range(3):
+            wall, dwall, results = _stream_window(
+                sched, lambda i: sched.push(kg.docs, insert(per_tick)), 6)
+            windows.append((wall, dwall, sum(r.delta_ops for r in results)))
+            log(f"4_knn insert window {w_ix}: {wall:.2f}s")
+        wall, dwall, dops = sorted(windows, key=lambda w: w[2] / w[0])[1]
 
         # one retraction tick: triggers the chunked full-corpus rescan.
         # Measured AFTER the window's barrier, so the wall carries one
@@ -415,7 +431,10 @@ def cfg5_image_embed(smoke: bool, log) -> None:
         from reflow_tpu.workloads import image_embed
 
         cfg = VIT_TINY if smoke else VIT_B_16
-        per_tick = 8 if smoke else 16
+        # 64-image batches: a 16-image tick leaves the chip ~99% idle
+        # (fixed per-execution overhead dominates); 64 is a realistic
+        # ETL ingestion batch and 4x the work per overhead payment
+        per_tick = 8 if smoke else 64
         ticks = 2 if smoke else 4
         n_groups = 64
         n_images = 1 << 14

@@ -481,16 +481,25 @@ def _lower_knn(op, node: Node, state, ins) -> Tuple[DeviceDelta, dict]:
     D = node.inputs[1].spec.key_space
     k = op.k
 
+    # an insert whose doc id is ALREADY live is an in-place update: its
+    # stale score may sit in a query's emitted top-k, and the
+    # incremental merge would keep treating it as a valid candidate —
+    # updates therefore rescan, exactly like retractions (checked
+    # against the PRE-fold live mask; padding rows have weight 0)
+    doc_update = jnp.any((dd.weights > 0) & state["dlive"][dd.keys])
+
     qvec, qlive = _fold_vectors(state["qvec"], state["qlive"], dq)
     dvec, dlive = _fold_vectors(state["dvec"], state["dlive"], dd)
     emitted, em_has = state["emitted"], state["em_has"]
     prec = (jax.lax.Precision.HIGHEST if op.precision == "highest"
             else jax.lax.Precision.DEFAULT)
 
-    # doc-insert and query-retract ticks take the incremental merge (a
-    # retracted query just stops emitting); query inserts/updates or doc
-    # retractions rescan the corpus (chunked, MXU)
-    need_full = jnp.any(dd.weights < 0) | jnp.any(dq.weights > 0)
+    # fresh doc-insert and query-retract ticks take the incremental
+    # merge (a retracted query just stops emitting); query
+    # inserts/updates, doc retractions and doc UPDATES rescan the
+    # corpus (chunked, MXU)
+    need_full = (jnp.any(dd.weights < 0) | jnp.any(dq.weights > 0)
+                 | doc_update)
 
     def full_path(_):
         return chunked_corpus_topk(qvec, dvec, dlive, k, op.scan_chunk,

@@ -284,3 +284,43 @@ def test_config_from_env_and_scheduler():
     sh = ReflowConfig.from_env({"REFLOW_EXECUTOR": "sharded",
                                 "REFLOW_MESH_DEVICES": "8"})
     assert sh.make_executor().n == 8
+
+
+def test_lazy_scalar_composition():
+    """LazyScalar defers host ints, device scalars, arrays and thunks
+    until int() — the mechanism keeping streaming ticks free of eager
+    per-tick scalar dispatches."""
+    import jax.numpy as jnp
+
+    from reflow_tpu.scheduler import LazyScalar, lazy_add
+
+    s = LazyScalar(3, jnp.asarray(4, jnp.int32))
+    s = s + 5
+    s = s + jnp.asarray([1, 2], jnp.int32)      # [K] stack sums
+    s = s + (lambda: 10)                        # deferred host thunk
+    assert int(s) == 3 + 4 + 5 + 3 + 10
+    assert lazy_add(1, 2) == 3                  # pure-host stays plain int
+    assert int(lazy_add(1, jnp.asarray(2, jnp.int32))) == 3
+
+
+def test_tick_many_guards():
+    """tick_many refuses pending push()es and non-source feeds."""
+    import pytest
+
+    from reflow_tpu.graph import GraphError
+    from reflow_tpu.workloads import wordcount
+
+    g, src, sink = wordcount.build_graph()
+    sched = DirtyScheduler(g)
+    sched.push(src, wordcount.ingest_lines(["a b"]))
+    with pytest.raises(GraphError, match="pending"):
+        sched.tick_many([{src: wordcount.ingest_lines(["c"])}])
+    sched.tick()
+    with pytest.raises(GraphError, match="sources"):
+        sched.tick_many([{sink: wordcount.ingest_lines(["c"])}])
+    # sink-bearing graph on the fallback path: sink deltas aggregate
+    agg = sched.tick_many(
+        [{src: wordcount.ingest_lines(["c d"])},
+         {src: wordcount.ingest_lines(["d"])}]).block()
+    assert agg.quiesced
+    assert dict(sched.view(sink.name)) and agg.deltas_in == 3

@@ -146,3 +146,25 @@ def test_bf16_embeddings_high_recall():
         hits += len(set(table[q]) & set(ref_ids[q]))
         total += K
     assert hits / total >= 0.95, f"bf16 recall {hits/total:.3f}"
+
+
+def test_in_place_doc_update_matches_oracle():
+    """Re-inserting a LIVE doc id with a new vector is an in-place
+    update; the stale score may sit in emitted top-k rows, so the device
+    path must take the full rescan (the incremental merge would keep the
+    stale candidate alive forever)."""
+    kg = knn.build_graph(Q, D, DIM, K, scan_chunk=D)
+    sched = DirtyScheduler(kg.graph, get_executor("tpu"))
+    store = knn.EmbeddingStore.create(DIM, seed=9)
+    rng = np.random.default_rng(109)
+    qvecs = rng.normal(size=(Q, DIM)).astype(np.float32)
+    sched.push(kg.queries, DeltaBatch(np.arange(Q), qvecs))
+    sched.push(kg.docs, store.insert_batch(np.arange(0, 64)))
+    sched.tick()
+    # overwrite docs 0..16 with fresh vectors via plain inserts
+    sched.push(kg.docs, store.insert_batch(np.arange(0, 16)))
+    sched.tick()
+    ref_ids, _ = store.reference_topk(qvecs, K)
+    table = _ids_table(sched, kg)
+    for q in range(Q):
+        np.testing.assert_array_equal(table[q], ref_ids[q])
