@@ -181,20 +181,18 @@ def run_pagerank_tpu_child() -> dict:
     # reads a value the last tick produced), so the median is honest
     # whichever mode it lands in.
     n = p["stream_ticks"]
-    from bench_configs import _stream_window
-    windows = []
-    for w_ix in range(3):
+    from bench_configs import _median_window, _stream_window
+
+    def run_churn_window():
         wall, dwall, results = _stream_window(
             sched, lambda i: sched.push(pr.edges, web.churn(p["churn"])), n)
         assert all(r.quiesced for r in results)
-        dops = sum(r.delta_ops for r in results)
-        windows.append({"wall_s": round(wall, 3),
-                        "dispatch_s": round(dwall, 3),
-                        "delta_ops": dops})
-        log(f"window {w_ix}: {wall:.2f}s for {n} ticks "
-            f"({dops / wall:,.0f} delta-ops/s)")
-    med = sorted(windows, key=lambda w: w["delta_ops"] / w["wall_s"])[1]
-    wall, dwall, dops = med["wall_s"], med["dispatch_s"], med["delta_ops"]
+        return wall, dwall, sum(r.delta_ops for r in results)
+
+    wall, dwall, dops, windows = _median_window(
+        run_churn_window, log, f"pagerank churn x{n}")
+    windows = [{"wall_s": round(w, 3), "dispatch_s": round(d, 3),
+                "delta_ops": o} for w, d, o in windows]
 
     # post-window extras (tunnel now degraded — every sync pays ~0.1s, so
     # these are conservative upper bounds, never enqueue times)

@@ -88,7 +88,9 @@ def _median_window(run_once, log, tag: str, n: int = 3):
     to completion, dwall ~= wall), so its smallness is the evidence the
     measurement was device-bound, not host-bound.
 
-    ``run_once() -> (wall_s, dispatch_wall_s, delta_ops)``.
+    ``run_once() -> (wall_s, dispatch_wall_s, delta_ops)``. Returns
+    ``(median_wall, window0_dispatch_wall, median_delta_ops, windows)``
+    with ``windows`` the full per-window list for diagnostics.
     """
     windows = []
     for ix in range(n):
@@ -96,8 +98,9 @@ def _median_window(run_once, log, tag: str, n: int = 3):
         windows.append((wall, dwall, dops))
         log(f"{tag} window {ix}: {wall:.2f}s "
             f"({dops / wall:,.0f} delta-ops/s)")
-    wall, _, dops = sorted(windows, key=lambda w: w[2] / w[0])[1]
-    return wall, windows[0][1], dops
+    ordered = sorted(windows, key=lambda w: w[2] / w[0])
+    wall, _, dops = ordered[len(ordered) // 2]
+    return wall, windows[0][1], dops, windows
 
 
 def _stream_window(sched, feed, n: int):
@@ -286,7 +289,7 @@ def cfg2_tfidf(smoke: bool, log) -> None:
                     pads.clear()
                     return wall, dwall, dops
 
-                wall, dwall, dops = _median_window(
+                wall, dwall, dops, _ = _median_window(
                     run_edit_window, log, "2_tfidf edit")
                 _record(log, f"2_tfidf_{ex_name}", {
                     "executor": ex_name,
@@ -335,7 +338,7 @@ def cfg2_tfidf(smoke: bool, log) -> None:
                     pads2.clear()
                     return wall2, dwall2, dops2
 
-                wall2, _, dops2 = _median_window(
+                wall2, _, dops2, _ = _median_window(
                     run_batched_window, log, "2_tfidf batched")
                 _record(log, "2_tfidf_tpu_batched", {
                     "executor": ex_name,
@@ -422,7 +425,7 @@ def cfg4_knn(smoke: bool, log) -> None:
                 sched, lambda i: sched.push(kg.docs, insert(per_tick)), 6)
             return wall, dwall, sum(r.delta_ops for r in results)
 
-        wall, dwall, dops = _median_window(
+        wall, dwall, dops, _ = _median_window(
             run_insert_window, log, "4_knn insert")
 
         # one retraction tick: triggers the chunked full-corpus rescan.
@@ -499,7 +502,7 @@ def cfg5_image_embed(smoke: bool, log) -> None:
                 ticks)
             return wall, dwall, sum(r.delta_ops for r in results)
 
-        wall, dwall, dops = _median_window(
+        wall, dwall, dops, _ = _median_window(
             run_image_window, log, "5_image_embed")
         # a group move: retract/insert pair through the model. Post-window
         # wall carries one degraded-tunnel sync — conservative, never an
