@@ -440,27 +440,31 @@ class LinearFixpointProgram(_MacroTickMixin):
 
             # per-tick CSR over the live arena slice (static in the loop;
             # arena keys are local under sharding — see join routing).
-            # Rebuilt from scratch each tick (~31ms device at 1.31M rows)
+            # Rebuilt from scratch each tick (~25-30ms device at 1.31M
+            # rows, argsort-dominated — tools/profile_tick.py)
             # deliberately: maintaining it incrementally would either
             # rewrite the full sorted table per tick (same cost as the
             # rebuild) or carry a fresh-rows tail swept densely by every
-            # pass, which at 1% churn x ~13 passes costs what the rebuild
+            # pass, which at 1% churn x ~12 passes costs what the rebuild
             # does — measured wash, so the simple form stays
             rk, rv, rw = jstate["rkeys"], jstate["rvals"], jstate["rw"]
             Rcap = rk.shape[0]
             skey = jnp.where(rw != 0, rk, Klc)
             order = jnp.argsort(skey)
-            sk = skey[order]
             svalw = jnp.concatenate(
                 [rv[order].reshape(Rcap, Q).astype(jnp.float32),
                  rw[order].astype(jnp.float32)[:, None]], axis=1)
-            bounds = jnp.searchsorted(
-                sk, jnp.arange(Klc + 1, dtype=jnp.int32)).astype(jnp.int32)
-            geo = jnp.stack([bounds[:Klc], bounds[1:] - bounds[:Klc]],
-                            axis=1).astype(jnp.float32)
+            # segment starts by scatter-count + exclusive cumsum, not
+            # searchsorted over the sorted keys: identical bounds (the
+            # sort groups equal keys contiguously, so start(k) = #keys<k)
+            # at a third of the cost (profiled 34ms -> 12ms at a 1.31M
+            # arena — tools/profile_tick.py)
+            deg_i = jnp.zeros((Klc + 1,), jnp.int32).at[skey].add(
+                1, mode="drop")[:Klc]
+            starts = jnp.cumsum(deg_i) - deg_i
+            geo = jnp.stack([starts, deg_i], axis=1).astype(jnp.float32)
             csr = (geo, svalw)
             arena = (jnp.minimum(rk, Klc - 1), rv, rw)
-            deg_i = (bounds[1:] - bounds[:Klc])
 
             branches = [
                 (lambda c, EB=EB: budget_body(EB, c[0], csr, c[1], base))
