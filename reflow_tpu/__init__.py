@@ -25,6 +25,7 @@ from reflow_tpu.delta import DeltaBatch, Spec
 from reflow_tpu.graph import FlowGraph
 from reflow_tpu.scheduler import DirtyScheduler
 from reflow_tpu.executors import CpuExecutor, Executor, get_executor
+from reflow_tpu.utils.config import ReflowConfig
 
 __version__ = "0.1.0"
 
@@ -36,5 +37,6 @@ __all__ = [
     "Executor",
     "CpuExecutor",
     "get_executor",
+    "ReflowConfig",
     "__version__",
 ]
