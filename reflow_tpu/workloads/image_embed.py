@@ -4,8 +4,8 @@ incremental groupby-agg, sharded over the mesh.
 BASELINE.md: "Image-embed ETL: ViT-B feature extract -> incremental
 groupby-agg, sharded on a TPU v4-8". The graph is::
 
-    images  source {image_id: [group_id, *flat_pixels]}
-    embed   Map(vit_forward)            -> [group_id, *features]
+    images  source {image_id: uint8 [group_byte, *raw_pixels]}
+    embed   Map(vit_forward)            -> f32 [group_id, *features]
     by_grp  GroupBy(key=group, value=features)
     cent    Reduce('mean')              {group: centroid}
 
@@ -39,6 +39,16 @@ class ImageEmbedGraph:
     centroids: Node  # read_table -> {group: mean feature vector}
 
 
+def pixels_to_input(px):
+    """uint8 pixels -> the model's [-1, 1] float input.
+
+    One definition shared by the device Map and the host oracle so the
+    differential tests compare the same forward pass. Works on numpy and
+    jax arrays alike.
+    """
+    return px.astype("float32") * np.float32(2.0 / 255.0) - np.float32(1.0)
+
+
 def build_graph(n_images: int, n_groups: int, params: Dict) -> ImageEmbedGraph:
     import jax.numpy as jnp
 
@@ -46,8 +56,14 @@ def build_graph(n_images: int, n_groups: int, params: Dict) -> ImageEmbedGraph:
     flat = cfg["img"] * cfg["img"] * cfg["chans"]
     dim = cfg["dim"]
     f32 = np.float32
+    if n_groups > 256:
+        raise ValueError("group id rides in the row's leading uint8 byte; "
+                         "n_groups must be <= 256 (ids 0-255)")
     g = FlowGraph("image_embed")
-    src = g.source("images", Spec((1 + flat,), f32, key_space=n_images))
+    # rows ship as RAW uint8 [group_byte | pixels] — what a real ETL
+    # ingests, and 4x less host->device traffic than f32 pixels (the
+    # measured bottleneck of config 5 over a ~50 MB/s tunnel)
+    src = g.source("images", Spec((1 + flat,), np.uint8, key_space=n_images))
 
     # weights ride as op params (compiled-program ARGUMENTS: VERDICT r2 #2
     # — closing over them traced ~86M ViT-B floats into a ~350MB HLO and
@@ -55,9 +71,10 @@ def build_graph(n_images: int, n_groups: int, params: Dict) -> ImageEmbedGraph:
     # shape-driving config is closed over
     weights = {k: v for k, v in params.items() if k != "_cfg"}
 
-    def embed(p, v):  # (weights, [C, 1+flat]) -> [C, 1+dim]
-        feats = vit_forward({**p, "_cfg": cfg}, v[:, 1:])
-        return jnp.concatenate([v[:, :1], feats], axis=-1)
+    def embed(p, v):  # (weights, [C, 1+flat] u8) -> [C, 1+dim] f32
+        feats = vit_forward({**p, "_cfg": cfg}, pixels_to_input(v[:, 1:]))
+        return jnp.concatenate([v[:, :1].astype(jnp.float32), feats],
+                               axis=-1)
 
     emb = g.map(src, embed, vectorized=True, params=weights,
                 spec=Spec((1 + dim,), f32, key_space=n_images), name="embed")
@@ -86,13 +103,13 @@ class ImageStream:
 
     def _row(self, i: int) -> np.ndarray:
         return np.concatenate(
-            [[np.float32(self.groups[i])], self.images[i]]).astype(np.float32)
+            [[np.uint8(self.groups[i])], self.images[i]]).astype(np.uint8)
 
     def insert(self, ids, groups) -> DeltaBatch:
         rows = []
         for i, grp in zip(ids, groups):
-            self.images[int(i)] = self.rng.normal(
-                size=self._flat()).astype(np.float32)
+            self.images[int(i)] = self.rng.integers(
+                0, 256, size=self._flat(), dtype=np.uint8)
             self.groups[int(i)] = int(grp)
             rows.append(self._row(int(i)))
         return DeltaBatch(np.asarray(ids, np.int64), np.stack(rows),
@@ -118,7 +135,8 @@ class ImageStream:
             return {}
         ids = sorted(self.images)
         feats = np.asarray(vit_forward(
-            self.params, np.stack([self.images[i] for i in ids])))
+            self.params,
+            pixels_to_input(np.stack([self.images[i] for i in ids]))))
         out: Dict[int, list] = {}
         for i, f in zip(ids, feats):
             out.setdefault(self.groups[i], []).append(f.astype(np.float64))
