@@ -1,0 +1,163 @@
+"""Randomized structured differential fuzzing (SURVEY.md §4b+§4c).
+
+Seeded random graphs composed from the device-lowerable op grammar —
+Map / Filter / GroupBy / Reduce(sum|count|mean) / Join(unique left) /
+Union — driven with random multi-tick delta sequences that retract
+exactly previously-inserted rows, and executed on all four executors:
+cpu (oracle), tpu, sharded (8-device virtual mesh), staged. All sink
+multisets must agree.
+
+Constraints baked into the generator (the same ones the executors
+enforce at bind): scalar f32 values, key_space divisible by the mesh,
+Join left side a Reduce output (unique) with a vectorized merge,
+arena capacities mesh-divisible, no min/max (insert-only on device),
+loop-free (fixpoint differentials live in test_pagerank/test_fixpoint),
+integer-valued floats so sum/count stay exact and only mean introduces
+rounding (compared at 3 decimals).
+"""
+
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from reflow_tpu import DirtyScheduler, FlowGraph
+from reflow_tpu.delta import DeltaBatch, Spec
+from reflow_tpu.executors import get_executor
+from reflow_tpu.parallel import make_mesh
+from reflow_tpu.parallel.shard import ShardedTpuExecutor
+from reflow_tpu.parallel.topo import StagedTpuExecutor
+
+K = 64          # key space: divisible by the 8-device mesh
+N_TICKS = 4
+ROWS_PER_TICK = 24
+
+
+def build_random_graph(rng: np.random.Generator):
+    """-> (graph, sources, sink). Construction order is topo order, so
+    stage assignment by node id is automatically stage-monotone."""
+    spec = Spec((), np.float32, key_space=K)
+    g = FlowGraph("fuzz")
+    sources = [g.source(f"s{i}", spec) for i in range(rng.integers(1, 3))]
+    streams = list(sources)     # non-unique delta streams
+    uniques = []                # Reduce outputs (unique-keyed)
+
+    n_ops = int(rng.integers(4, 9))
+    for ix in range(n_ops):
+        kind = rng.choice(["map", "filter", "groupby", "reduce", "union",
+                           "join"])
+        if kind == "map":
+            a, b = int(rng.integers(1, 4)), int(rng.integers(0, 5))
+            node = g.map(rng.choice(streams),
+                         lambda v, a=a, b=b: v * np.float32(a) + np.float32(b),
+                         vectorized=True)
+            streams.append(node)
+        elif kind == "filter":
+            c = float(rng.integers(0, 6))
+            node = g.filter(rng.choice(streams),
+                            lambda v, c=c: v > c, vectorized=True)
+            streams.append(node)
+        elif kind == "groupby":
+            m, s = int(rng.integers(1, 5)), int(rng.integers(0, K))
+            node = g.group_by(
+                rng.choice(streams),
+                key_fn=lambda k, v, m=m, s=s: (k * m + s) % K,
+                vectorized=True)
+            streams.append(node)
+        elif kind == "reduce":
+            how = rng.choice(["sum", "count", "mean"])
+            node = g.reduce(rng.choice(streams), how,
+                            tol=1e-6 if how != "count" else 0.0)
+            uniques.append(node)
+            streams.append(node)   # emissions are themselves a stream
+        elif kind == "union":
+            a, b = rng.choice(streams), rng.choice(streams)
+            streams.append(g.union(a, b))
+        elif kind == "join":
+            if not uniques:
+                continue
+            left = rng.choice(uniques)
+            right = rng.choice(streams)
+            w = int(rng.integers(1, 3))
+            node = g.join(
+                left, right,
+                merge=lambda k, va, vb, w=w: va + np.float32(w) * vb,
+                arena_capacity=1 << 12)
+            streams.append(node)
+    sink = g.sink(streams[-1], "out")
+
+    # stage assignment for the staged executor: two contiguous stages
+    # split at the median op id (ids are topo order -> monotone edges)
+    op_ids = [n.id for n in g.nodes if n.kind == "op"]
+    if op_ids:
+        cut = op_ids[len(op_ids) // 2]
+        for n in g.nodes:
+            if n.kind == "op":
+                n.stage = 0 if n.id <= cut else 1
+    return g, sources, sink
+
+
+def random_ticks(rng: np.random.Generator, n_sources: int):
+    """Delta sequence: inserts plus exact retractions of earlier rows."""
+    ticks = []
+    log = [[] for _ in range(n_sources)]   # per-source inserted rows
+    for _ in range(N_TICKS):
+        tick = []
+        for s in range(n_sources):
+            rows = []
+            for _ in range(ROWS_PER_TICK):
+                if log[s] and rng.random() < 0.3:
+                    # pop: each inserted row is retracted at most once,
+                    # so source collections never go net-negative
+                    k, v, w = log[s].pop(int(rng.integers(0, len(log[s]))))
+                    rows.append((k, v, -w))   # exact retraction
+                else:
+                    row = (int(rng.integers(0, K)),
+                           float(rng.integers(0, 8)),
+                           int(rng.integers(1, 3)))
+                    rows.append(row)
+                    log[s].append(row)
+            tick.append((s, rows))
+        ticks.append(tick)
+    return ticks
+
+
+def run_on(executor, g, sources, sink, ticks):
+    sched = DirtyScheduler(g, executor)
+    for tick in ticks:
+        for s_ix, rows in tick:
+            sched.push(sources[s_ix], DeltaBatch(
+                np.array([r[0] for r in rows], np.int64),
+                np.array([r[1] for r in rows], np.float32),
+                np.array([r[2] for r in rows], np.int64)))
+        sched.tick()
+    return Counter({(int(k), round(float(v), 3)): w
+                    for (k, v), w in sched.view(sink).items() if w})
+
+
+@pytest.mark.parametrize("seed", list(range(8)))
+def test_random_graph_all_executors_agree(seed):
+    rng = np.random.default_rng(seed)
+    graph_seed = rng.integers(0, 1 << 30)
+    ticks_seed = rng.integers(0, 1 << 30)
+
+    n_sources = len(build_random_graph(np.random.default_rng(graph_seed))[1])
+    ticks = random_ticks(np.random.default_rng(ticks_seed), n_sources)
+
+    views = {}
+    for name in ("cpu", "tpu", "sharded", "staged"):
+        # fresh graph per executor: schedulers freeze/bind their graph
+        g, sources, sink = build_random_graph(np.random.default_rng(graph_seed))
+        ex = {
+            "cpu": lambda: get_executor("cpu"),
+            "tpu": lambda: get_executor("tpu"),
+            "sharded": lambda: ShardedTpuExecutor(make_mesh(8)),
+            "staged": lambda: StagedTpuExecutor(),
+        }[name]()
+        views[name] = run_on(ex, g, sources, sink, ticks)
+
+    for name in ("tpu", "sharded", "staged"):
+        assert views[name] == views["cpu"], (
+            f"seed {seed}: {name} disagrees with cpu oracle:\n"
+            f"only-{name}: {views[name] - views['cpu']}\n"
+            f"only-cpu: {views['cpu'] - views[name]}")
