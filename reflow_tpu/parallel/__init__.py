@@ -21,9 +21,10 @@ and the benchmark harness.
 """
 
 from reflow_tpu.parallel.mesh import (DELTA_AXIS, make_mesh, replicate,
-                                      shard_state_tree)
+                                      shard_batch, shard_state_tree)
 
-__all__ = ["DELTA_AXIS", "make_mesh", "replicate", "shard_state_tree",
+__all__ = ["DELTA_AXIS", "make_mesh", "replicate", "shard_batch",
+           "shard_state_tree",
            "StagedTpuExecutor", "ShardedTpuExecutor"]
 
 

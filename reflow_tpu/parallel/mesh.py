@@ -19,7 +19,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["DELTA_AXIS", "make_mesh", "shard_state_tree", "replicate"]
+__all__ = ["DELTA_AXIS", "make_mesh", "shard_batch", "shard_state_tree",
+           "replicate"]
 
 #: name of the mesh axis delta rows and key ranges are sharded over
 DELTA_AXIS = "delta"
@@ -70,3 +71,78 @@ def replicate(tree, mesh: Mesh):
     """Fully replicate a pytree over the mesh."""
     sh = NamedSharding(mesh, P())
     return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+
+def shard_batch(chunks, spec, mesh: Mesh, *, capacity=None,
+                axis_name: str = DELTA_AXIS):
+    """Assemble a row-sharded DeviceDelta from per-shard host chunks.
+
+    ``chunks`` is one host :class:`~reflow_tpu.delta.DeltaBatch` per mesh
+    device (length = mesh size), each padded to ``capacity // n`` rows
+    with weight-0 padding and transferred host->owner-device in one hop —
+    ``jax.make_array_from_single_device_arrays`` then stitches them into
+    one global row-sharded array per column with no cross-device traffic.
+    Push the result like any batch: the scheduler and the sharded
+    executor accept device-resident ingress as-is.
+
+    This is the single-controller form of the multi-host ingestion
+    recipe: under multi-controller JAX each process builds its LOCAL
+    chunks the same way and uses
+    ``jax.make_array_from_process_local_data`` with the same sharding —
+    the SPMD tick consumes either identically.
+    """
+    from reflow_tpu.delta import DeltaBatch
+    from reflow_tpu.executors.device_delta import (DeviceDelta,
+                                                   bucket_capacity)
+
+    if len(mesh.axis_names) != 1:
+        raise ValueError("shard_batch expects a 1-D mesh (one row axis); "
+                         f"got axes {mesh.axis_names}")
+    n = mesh.shape[axis_name]
+    if len(chunks) != n:
+        raise ValueError(f"need one chunk per mesh device ({n}), "
+                         f"got {len(chunks)}")
+    if capacity is not None and (capacity <= 0 or capacity % n):
+        raise ValueError(
+            f"capacity {capacity} must be a positive multiple of the "
+            f"mesh size {n}")
+    per = (capacity // n if capacity is not None
+           else bucket_capacity(max(len(c) for c in chunks)))
+    # the SAME exactness bound every host->device path enforces — checked
+    # on the GLOBAL batch: after key routing all shards' contributions
+    # fold into one f32 table, so per-chunk mass alone would under-guard
+    total_mass = sum(int(np.abs(np.asarray(c.weights)).sum())
+                     for c in chunks if len(c))
+    if total_mass >= 1 << 24:
+        raise ValueError(
+            "batch weight mass >= 2**24 exceeds the device path's exact "
+            "float32 range; split the batch across ticks")
+
+    def pad_cols(c: DeltaBatch):
+        m = len(c)
+        if m > per:
+            raise ValueError(f"chunk of {m} rows exceeds per-shard "
+                             f"capacity {per}")
+        keys = np.zeros(per, np.int32)
+        weights = np.zeros(per, np.int32)
+        values = np.zeros((per,) + tuple(spec.value_shape), spec.value_dtype)
+        if m:
+            keys[:m] = c.keys.astype(np.int64)
+            weights[:m] = c.weights
+            values[:m] = np.asarray(c.values).reshape(
+                (m,) + tuple(spec.value_shape))
+        return keys, values, weights
+
+    devs = list(mesh.devices.ravel())
+    # one host->owner transfer per chunk (numpy -> device d directly;
+    # routing through the default device would double-hop n-1 chunks)
+    locals_ = [jax.device_put(pad_cols(c), d) for c, d in zip(chunks, devs)]
+    sharding = NamedSharding(mesh, P(axis_name))
+
+    def stitch(ix):
+        shards = [l[ix] for l in locals_]
+        shape = (n * per,) + shards[0].shape[1:]
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding, shards)
+
+    return DeviceDelta(stitch(0), stitch(1), stitch(2))

@@ -293,3 +293,31 @@ def test_sharded_macro_tick_matches_sequential(mesh):
     assert set(ranks_a) == set(ranks_b)
     for k in ranks_a:
         assert float(ranks_a[k]) == float(ranks_b[k])
+
+
+def test_shard_batch_presharded_ingress_matches_host_push(mesh):
+    """parallel.mesh.shard_batch builds a row-sharded DeviceDelta from
+    per-shard host chunks (the single-controller form of the multi-host
+    ingestion recipe); pushing it must equal pushing the equivalent
+    host batch."""
+    from reflow_tpu.parallel.mesh import shard_batch
+
+    K = 64
+    rng = np.random.default_rng(21)
+    n = 8 * 16
+    keys = rng.integers(0, K, n)
+    w = np.where(rng.random(n) < 0.25, -1, 1)
+    vals = np.ones(n, np.float32)
+
+    g1, s1, _ = _reduce_graph(K)
+    a = DirtyScheduler(g1, ShardedTpuExecutor(mesh))
+    a.push(s1, DeltaBatch(keys, vals, w))
+    a.tick()
+
+    g2, s2, _ = _reduce_graph(K)
+    b = DirtyScheduler(g2, ShardedTpuExecutor(mesh))
+    chunks = [DeltaBatch(keys[i::8], vals[i::8], w[i::8]) for i in range(8)]
+    b.push(s2, shard_batch(chunks, s2.spec, mesh))
+    b.tick()
+
+    assert dict(a.view_dict("out")) == dict(b.view_dict("out"))
