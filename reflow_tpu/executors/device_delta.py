@@ -82,8 +82,14 @@ def check_weight_mass(batch: DeltaBatch) -> None:
 
 
 def to_device(batch: DeltaBatch, spec: Spec,
-              capacity: Optional[int] = None) -> DeviceDelta:
-    """Host DeltaBatch -> padded DeviceDelta (the source host boundary)."""
+              capacity: Optional[int] = None,
+              device=None) -> DeviceDelta:
+    """Host DeltaBatch -> padded DeviceDelta (the source host boundary).
+
+    ``device`` places the columns directly on a specific device in one
+    host->device hop (the pre-sharded ingestion path,
+    ``parallel.mesh.shard_batch``); None uses the default device.
+    """
     n = len(batch)
     cap = capacity if capacity is not None else bucket_capacity(n)
     if n > cap:
@@ -99,6 +105,10 @@ def to_device(batch: DeltaBatch, spec: Spec,
             np.stack([np.asarray(v) for v in batch.values])
             if batch.values.dtype == object else batch.values
         ).reshape((n,) + tuple(spec.value_shape))
+    if device is not None:
+        import jax
+
+        return DeviceDelta(*jax.device_put((keys, values, weights), device))
     return DeviceDelta(jnp.asarray(keys), jnp.asarray(values), jnp.asarray(weights))
 
 

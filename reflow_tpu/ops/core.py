@@ -332,6 +332,15 @@ class Join(Op):
     δ(A⋈B) = δA⋈B + (A+δA)⋈δB. Output rows are
     ``(key, merge(key, va, vb))`` with weight ``wa*wb``; ``merge`` defaults
     to the tuple ``(va, vb)``.
+
+    Merge contract: values arrive ARRAY-LIKE on both executors — per row
+    on the CPU oracle (scalars stay scalars; vector values arrive as 1-D
+    float64 arrays), batched with a leading row axis on the device path.
+    Elementwise expressions (``va + vb``) therefore behave identically on
+    both; a merge that needs to tell the forms apart branches on ``ndim``
+    (see ``workloads/pagerank._contrib_merge``). Host multiset state
+    stays hashable internally (tuples) — the conversion happens at this
+    call boundary, both ways.
     """
 
     kind = "join"
@@ -362,7 +371,27 @@ class Join(Op):
         return (defaultdict(Counter), defaultdict(Counter))
 
     def _emit(self, out: Counter, k, va, wa, vb, wb):
-        v = self.merge(k, va, vb) if self.merge else (va, vb)
+        if self.merge is None:
+            out[(k, (va, vb))] += wa * wb
+            return
+        # NUMERIC vector values live as hashable TUPLES in the host
+        # multiset state; the device path hands merge jax ARRAYS. Convert
+        # at the boundary both ways so one array-style merge (e.g.
+        # ``lambda k, va, vb: va + vb`` meaning elementwise) serves both
+        # executors — without this, tuple + tuple would concatenate.
+        # Non-numeric / nested tuples (host-only graphs: strings, a
+        # default join's (va, vb) pairs) pass through untouched.
+        def to_arr(v):
+            if isinstance(v, tuple):
+                try:
+                    return np.asarray(v, np.float64)
+                except (ValueError, TypeError):
+                    return v
+            return v
+
+        v = self.merge(k, to_arr(va), to_arr(vb))
+        if isinstance(v, np.ndarray):
+            v = tuple(v.tolist())
         out[(k, v)] += wa * wb
 
     def apply(self, state, in_batches):

@@ -91,9 +91,8 @@ def shard_batch(chunks, spec, mesh: Mesh, *, capacity=None,
     ``jax.make_array_from_process_local_data`` with the same sharding —
     the SPMD tick consumes either identically.
     """
-    from reflow_tpu.delta import DeltaBatch
     from reflow_tpu.executors.device_delta import (DeviceDelta,
-                                                   bucket_capacity)
+                                                   bucket_capacity, to_device)
 
     if len(mesh.axis_names) != 1:
         raise ValueError("shard_batch expects a 1-D mesh (one row axis); "
@@ -118,31 +117,18 @@ def shard_batch(chunks, spec, mesh: Mesh, *, capacity=None,
             "batch weight mass >= 2**24 exceeds the device path's exact "
             "float32 range; split the batch across ticks")
 
-    def pad_cols(c: DeltaBatch):
-        m = len(c)
-        if m > per:
-            raise ValueError(f"chunk of {m} rows exceeds per-shard "
-                             f"capacity {per}")
-        keys = np.zeros(per, np.int32)
-        weights = np.zeros(per, np.int32)
-        values = np.zeros((per,) + tuple(spec.value_shape), spec.value_dtype)
-        if m:
-            keys[:m] = c.keys.astype(np.int64)
-            weights[:m] = c.weights
-            values[:m] = np.asarray(c.values).reshape(
-                (m,) + tuple(spec.value_shape))
-        return keys, values, weights
-
     devs = list(mesh.devices.ravel())
-    # one host->owner transfer per chunk (numpy -> device d directly;
-    # routing through the default device would double-hop n-1 chunks)
-    locals_ = [jax.device_put(pad_cols(c), d) for c, d in zip(chunks, devs)]
+    # one host->owner transfer per chunk (to_device pads/casts exactly as
+    # the ordinary push path and lands on d directly; routing through the
+    # default device would double-hop n-1 chunks)
+    locals_ = [to_device(c, spec, capacity=per, device=d)
+               for c, d in zip(chunks, devs)]
     sharding = NamedSharding(mesh, P(axis_name))
 
-    def stitch(ix):
-        shards = [l[ix] for l in locals_]
+    def stitch(col):
+        shards = [getattr(l, col) for l in locals_]
         shape = (n * per,) + shards[0].shape[1:]
         return jax.make_array_from_single_device_arrays(
             shape, sharding, shards)
 
-    return DeviceDelta(stitch(0), stitch(1), stitch(2))
+    return DeviceDelta(stitch("keys"), stitch("values"), stitch("weights"))

@@ -88,12 +88,12 @@ def build_graph(n_nodes: int, *, damping: float = DAMPING, tol: float = 1e-4,
 def _contrib_merge(k, rank, vb):
     """(rank, [dst, invdeg]) -> [dst, rank·invdeg].
 
-    Dual contract: the CPU oracle calls merge per row with ``vb`` a 2-tuple;
-    the device Join calls it once with batched arrays ``rank: f32[R]``,
-    ``vb: f32[R, 2]``.
+    Merge contract (ops/core.py Join): values arrive array-like — per-row
+    on the CPU oracle (``vb: f64[2]``, ``rank`` scalar), batched on the
+    device path (``vb: f32[R, 2]``, ``rank: f32[R]``); branch on ndim.
     """
-    if isinstance(vb, tuple):
-        return (vb[0], rank * vb[1])
+    if getattr(vb, "ndim", 1) <= 1:
+        return np.asarray([vb[0], rank * vb[1]])
     import jax.numpy as jnp
 
     return jnp.stack([vb[:, 0], rank * vb[:, 1]], axis=-1)

@@ -161,3 +161,148 @@ def test_random_graph_all_executors_agree(seed):
             f"seed {seed}: {name} disagrees with cpu oracle:\n"
             f"only-{name}: {views[name] - views['cpu']}\n"
             f"only-cpu: {views['cpu'] - views[name]}")
+
+
+def run_streaming(executor, g, sources, reduces, ticks):
+    """Sink-free drive in streaming mode: push + tick(sync=False) per
+    tick, block at the end, read every Reduce table."""
+    sched = DirtyScheduler(g, executor)
+    results = []
+    for tick in ticks:
+        for s_ix, rows in tick:
+            sched.push(sources[s_ix], DeltaBatch(
+                np.array([r[0] for r in rows], np.int64),
+                np.array([r[1] for r in rows], np.float32),
+                np.array([r[2] for r in rows], np.int64)))
+        results.append(sched.tick(sync=False))
+    for r in results:
+        r.block()
+    out = {}
+    for ix, node in enumerate(reduces):
+        out[ix] = {int(k): round(float(np.asarray(v).reshape(())), 3)
+                   for k, v in sched.read_table(node).items()}
+    return out
+
+
+def build_streaming_graph(rng: np.random.Generator):
+    """Sink-free random graph (streaming ticks defer ALL readbacks when
+    no sink forces materialization); every Reduce output is observable
+    via read_table, so the differential compares every aggregate table."""
+    spec = Spec((), np.float32, key_space=K)
+    g = FlowGraph("fuzz_stream")
+    sources = [g.source(f"s{i}", spec) for i in range(rng.integers(1, 3))]
+    streams = list(sources)
+    reduces = []
+    for _ in range(int(rng.integers(3, 7))):
+        kind = rng.choice(["map", "groupby", "reduce", "union"])
+        if kind == "map":
+            a = int(rng.integers(1, 4))
+            streams.append(g.map(rng.choice(streams),
+                                 lambda v, a=a: v * np.float32(a),
+                                 vectorized=True))
+        elif kind == "groupby":
+            m = int(rng.integers(1, 5))
+            streams.append(g.group_by(
+                rng.choice(streams),
+                key_fn=lambda k, v, m=m: (k * m) % K, vectorized=True))
+        elif kind == "reduce":
+            node = g.reduce(rng.choice(streams), rng.choice(["sum", "count"]),
+                            tol=0.0)
+            reduces.append(node)
+            streams.append(node)
+        else:
+            streams.append(g.union(rng.choice(streams), rng.choice(streams)))
+    if not reduces:
+        reduces.append(g.reduce(streams[-1], "sum"))
+    return g, sources, reduces
+
+
+@pytest.mark.parametrize("seed", [10, 11, 12])
+def test_streaming_random_graph_all_executors_agree(seed):
+    """The streaming (sync=False) path — the headline benchmark's mode —
+    fuzzed across executors on sink-free graphs."""
+    rng = np.random.default_rng(seed)
+    graph_seed = rng.integers(0, 1 << 30)
+    ticks_seed = rng.integers(0, 1 << 30)
+    n_sources = len(build_streaming_graph(
+        np.random.default_rng(graph_seed))[1])
+    ticks = random_ticks(np.random.default_rng(ticks_seed), n_sources)
+
+    views = {}
+    for name in ("cpu", "tpu", "sharded"):
+        g, sources, reduces = build_streaming_graph(
+            np.random.default_rng(graph_seed))
+        ex = {
+            "cpu": lambda: get_executor("cpu"),
+            "tpu": lambda: get_executor("tpu"),
+            "sharded": lambda: ShardedTpuExecutor(make_mesh(8)),
+        }[name]()
+        views[name] = run_streaming(ex, g, sources, reduces, ticks)
+    assert views["tpu"] == views["cpu"], f"seed {seed}"
+    assert views["sharded"] == views["cpu"], f"seed {seed}"
+
+
+def build_vector_graph(rng: np.random.Generator):
+    """Vector-valued collections: value_shape (3,) through Map / Reduce /
+    Join (vector merge), exercising [K, V] state tables."""
+    spec = Spec((3,), np.float32, key_space=K)
+    g = FlowGraph("fuzz_vec")
+    src = g.source("s0", spec)
+    a = float(rng.integers(1, 4))
+    m = g.map(src, lambda v, a=a: v * np.float32(a), vectorized=True)
+    red = g.reduce(m, "sum", tol=1e-6)
+    j = g.join(red, src, merge=lambda k, va, vb: va + vb,
+               arena_capacity=1 << 12)
+    total = g.reduce(j, rng.choice(["sum", "mean"]), tol=1e-6)
+    sink = g.sink(total, "out")
+    return g, [src], sink
+
+
+def vector_ticks(rng: np.random.Generator):
+    ticks = []
+    log = []
+    for _ in range(N_TICKS):
+        rows = []
+        for _ in range(ROWS_PER_TICK):
+            if log and rng.random() < 0.3:
+                k, v, w = log.pop(int(rng.integers(0, len(log))))
+                rows.append((k, v, -w))
+            else:
+                row = (int(rng.integers(0, K)),
+                       tuple(float(x) for x in rng.integers(0, 5, 3)),
+                       int(rng.integers(1, 3)))
+                rows.append(row)
+                log.append(row)
+        ticks.append(rows)
+    return ticks
+
+
+@pytest.mark.parametrize("seed", [20, 21, 22])
+def test_vector_values_all_executors_agree(seed):
+    rng = np.random.default_rng(seed)
+    graph_seed = rng.integers(0, 1 << 30)
+    ticks_seed = rng.integers(0, 1 << 30)
+    ticks = vector_ticks(np.random.default_rng(ticks_seed))
+
+    views = {}
+    for name in ("cpu", "tpu", "sharded", "staged"):
+        g, (src,), sink = build_vector_graph(
+            np.random.default_rng(graph_seed))
+        ex = {
+            "cpu": lambda: get_executor("cpu"),
+            "tpu": lambda: get_executor("tpu"),
+            "sharded": lambda: ShardedTpuExecutor(make_mesh(8)),
+            "staged": lambda: StagedTpuExecutor(),
+        }[name]()
+        sched = DirtyScheduler(g, ex)
+        for rows in ticks:
+            sched.push(src, DeltaBatch(
+                np.array([r[0] for r in rows], np.int64),
+                np.array([r[1] for r in rows], np.float32),
+                np.array([r[2] for r in rows], np.int64)))
+            sched.tick()
+        views[name] = Counter(
+            {(int(k), tuple(round(float(x), 3) for x in np.ravel(v))): w
+             for (k, v), w in sched.view(sink).items() if w})
+    for name in ("tpu", "sharded", "staged"):
+        assert views[name] == views["cpu"], f"seed {seed}: {name} diverges"
