@@ -106,8 +106,6 @@ def to_device(batch: DeltaBatch, spec: Spec,
             if batch.values.dtype == object else batch.values
         ).reshape((n,) + tuple(spec.value_shape))
     if device is not None:
-        import jax
-
         return DeviceDelta(*jax.device_put((keys, values, weights), device))
     return DeviceDelta(jnp.asarray(keys), jnp.asarray(values), jnp.asarray(weights))
 

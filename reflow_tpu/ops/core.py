@@ -326,6 +326,18 @@ def _close(a, b, tol: float) -> bool:
         return a == b
 
 
+def _merge_arg(v):
+    """Host-boundary form of a join value handed to ``merge``: numeric
+    tuples become f64 arrays (the array-like contract); anything else —
+    scalars, strings, nested host-only tuples — passes through."""
+    if isinstance(v, tuple):
+        try:
+            return np.asarray(v, np.float64)
+        except (ValueError, TypeError):
+            return v
+    return v
+
+
 class Join(Op):
     """Incremental binary equi-join with per-side multiset state.
 
@@ -381,17 +393,11 @@ class Join(Op):
         # executors — without this, tuple + tuple would concatenate.
         # Non-numeric / nested tuples (host-only graphs: strings, a
         # default join's (va, vb) pairs) pass through untouched.
-        def to_arr(v):
-            if isinstance(v, tuple):
-                try:
-                    return np.asarray(v, np.float64)
-                except (ValueError, TypeError):
-                    return v
-            return v
-
-        v = self.merge(k, to_arr(va), to_arr(vb))
+        v = self.merge(k, _merge_arg(va), _merge_arg(vb))
         if isinstance(v, np.ndarray):
-            v = tuple(v.tolist())
+            from reflow_tpu.delta import _hashable
+
+            v = _hashable(v)
         out[(k, v)] += wa * wb
 
     def apply(self, state, in_batches):

@@ -1,0 +1,184 @@
+"""Structural fuzzing of the declared-linear fixpoint (SURVEY.md §2 #13).
+
+Random linear loop regions over the full chain grammar analyze_linear
+matches — ``loop -> Join(linear_left) -> [GroupBy] -> [linear Maps] ->
+Union(base) -> Reduce('sum', tol) -> close_loop`` — with random
+contraction coefficients (per-source |coef| mass bounded so the
+iteration provably converges), random base injections, and churn ticks
+that retract exact edge rows. Four executions per seed:
+
+  cpu            host oracle (host-driven loop)
+  tpu (linear)   the fused delta-vector program (asserted engaged)
+  tpu (row)      the row-based lax.while_loop program
+  sharded        the shard_map'd fused loop on the 8-device mesh
+
+All four must agree on the converged Reduce table (atol 2e-3: f32
+emission vs the host's f64, both tol-gated at 1e-4).
+"""
+
+import numpy as np
+import pytest
+
+from reflow_tpu import DirtyScheduler, FlowGraph
+from reflow_tpu.delta import DeltaBatch, Spec
+from reflow_tpu.executors.tpu import TpuExecutor
+from reflow_tpu.parallel import make_mesh
+from reflow_tpu.parallel.shard import ShardedTpuExecutor
+
+K = 64
+N_EDGES = 320
+CHURN_TICKS = 3
+
+
+def _edge_merge(k, x, vb):
+    """[dst, coef] routed-contribution merge (ndim-branching contract)."""
+    if getattr(vb, "ndim", 1) <= 1:
+        return np.asarray([vb[0], x * vb[1]])
+    import jax.numpy as jnp
+
+    return jnp.stack([vb[:, 0], x * vb[:, 1]], axis=-1)
+
+
+def build_linear_loop(rng: np.random.Generator):
+    """Random declared-linear region; returns (graph, base, edges, reduce,
+    uses_groupby)."""
+    rank_spec = Spec((), np.float32, key_space=K, unique=True)
+    scalar = Spec((), np.float32, key_space=K)
+    edge2 = Spec((2,), np.float32, key_space=K)
+    use_groupby = bool(rng.random() < 0.7)
+    n_maps = int(rng.integers(0, 3))
+    map_cs = [int(rng.integers(1, 3)) for _ in range(n_maps)]
+
+    g = FlowGraph("linfuzz")
+    base = g.source("base", scalar)
+    edges = g.source("edges", edge2 if use_groupby else scalar)
+    x = g.loop("x", rank_spec)
+    if use_groupby:
+        j = g.join(x, edges, merge=_edge_merge, spec=edge2,
+                   linear_left=True, arena_capacity=1 << 13)
+        node = g.group_by(j, key_fn=lambda k, v: v[:, 0].astype("int32"),
+                          value_fn=lambda k, v: v[:, 1],
+                          vectorized=True, spec=scalar)
+    else:
+        # per-key decay: x'[k] = base[k] + coef_sum[k] * x[k]
+        node = g.join(x, edges, merge=lambda k, xa, vb: xa * vb,
+                      spec=scalar, linear_left=True,
+                      arena_capacity=1 << 13)
+    for c in map_cs:
+        node = g.map(node, lambda v, c=c: v * np.float32(c),
+                     vectorized=True, linear=True)
+    u = g.union(node, base)
+    red = g.reduce(u, "sum", tol=1e-4, spec=rank_spec)
+    g.close_loop(x, red)
+    return g, base, edges, red, use_groupby, map_cs
+
+
+def edge_rows(rng, n, use_groupby, map_scale, mass):
+    """Random edges drawing coefficients from each source's REMAINING
+    contraction budget (0.9 / map_scale total per source, across ALL live
+    edges — ``mass`` tracks what's already spent), so the loop contracts
+    even as churn adds edges. Updates ``mass`` in place."""
+    src = rng.integers(0, K, n)
+    dst = rng.integers(0, K, n)
+    raw = rng.random(n) + 0.1
+    per_src = np.zeros(K)
+    np.add.at(per_src, src, raw)
+    budget = np.maximum(0.9 / map_scale - mass, 0.0)
+    coef = np.round(raw * budget[src] / per_src[src], 4)
+    coef = coef.astype(np.float32)
+    np.add.at(mass, src, np.abs(coef))
+    if use_groupby:
+        vals = np.stack([dst.astype(np.float32), coef], axis=1)
+    else:
+        vals = coef
+    return src.astype(np.int64), vals
+
+
+def drive(executor, g, base, edges, red, ticks):
+    sched = DirtyScheduler(g, executor, max_loop_iters=500)
+    for tick in ticks:
+        for src_node, batch in tick:
+            sched.push({"base": base, "edges": edges}[src_node], batch)
+        r = sched.tick()
+        assert r.quiesced
+    return sched.read_table(red)
+
+
+def make_ticks(rng, use_groupby, map_scale):
+    mass = np.zeros(K)
+    src, vals = edge_rows(rng, N_EDGES, use_groupby, map_scale, mass)
+    w = np.ones(N_EDGES, np.int64)
+    bkeys = np.arange(K, dtype=np.int64)
+    bvals = np.round(rng.random(K), 3).astype(np.float32) + 0.05
+    ticks = [[("base", DeltaBatch(bkeys, bvals, np.ones(K, np.int64))),
+              ("edges", DeltaBatch(src, vals, w))]]
+    live = list(range(N_EDGES))
+    for _ in range(CHURN_TICKS):
+        n_ch = int(rng.integers(4, 20))
+        pick = rng.choice(len(live), size=min(n_ch, len(live)),
+                          replace=False)
+        idx = [live[p] for p in sorted(pick, reverse=True)]
+        for p in sorted(pick, reverse=True):
+            live.pop(p)
+        retract = DeltaBatch(src[idx], vals[idx],
+                             -np.ones(len(idx), np.int64))
+        # retracted coefficient mass returns to its source's budget
+        rcoef = vals[idx][:, 1] if use_groupby else vals[idx]
+        np.add.at(mass, src[idx], -np.abs(rcoef.astype(np.float64)))
+        nsrc, nvals = edge_rows(rng, len(idx), use_groupby, map_scale,
+                                mass)
+        # appended rows extend the live set for later churn of churn
+        src = np.concatenate([src, nsrc])
+        vals = np.concatenate([vals, nvals])
+        live.extend(range(len(src) - len(idx), len(src)))
+        insert = DeltaBatch(nsrc, nvals, np.ones(len(idx), np.int64))
+        ticks.append([("edges", DeltaBatch.concat([retract, insert]))])
+    return ticks
+
+
+def as_vec(table):
+    v = np.zeros(K)
+    for k, val in table.items():
+        v[int(k)] = float(np.asarray(val).reshape(()))
+    return v
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_random_linear_loop_all_programs_agree(seed):
+    rng = np.random.default_rng(100 + seed)
+    graph_seed = int(rng.integers(0, 1 << 30))
+    tick_seed = int(rng.integers(0, 1 << 30))
+
+    def fresh():
+        return build_linear_loop(np.random.default_rng(graph_seed))
+
+    g0, _, _, _, use_groupby, map_cs = fresh()
+    map_scale = float(np.prod(map_cs)) if map_cs else 1.0
+    ticks = make_ticks(np.random.default_rng(tick_seed), use_groupby,
+                       map_scale)
+
+    tables = {}
+    execs = {
+        "cpu": lambda: None,   # DirtyScheduler default
+        "tpu_linear": lambda: TpuExecutor(),
+        "tpu_row": lambda: TpuExecutor(linear_fixpoint=False),
+        "sharded": lambda: ShardedTpuExecutor(make_mesh(8)),
+    }
+    for name, mk in execs.items():
+        g, base, edges, red, _, _ = fresh()
+        ex = mk()
+        if ex is None:
+            from reflow_tpu.executors import CpuExecutor
+            ex = CpuExecutor()
+        tables[name] = drive(ex, g, base, edges, red, ticks)
+        if name == "tpu_linear":
+            assert ex._linear_structure is not None, (
+                f"seed {seed}: analyze_linear did not match the region "
+                f"(groupby={use_groupby}, maps={map_cs})")
+
+    ref = as_vec(tables["cpu"])
+    for name in ("tpu_linear", "tpu_row", "sharded"):
+        np.testing.assert_allclose(
+            as_vec(tables[name]), ref, atol=2e-3,
+            err_msg=f"seed {seed}: {name} diverges "
+                    f"(groupby={use_groupby}, maps={map_cs})")
