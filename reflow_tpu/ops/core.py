@@ -22,7 +22,8 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 
 import numpy as np
 
-from reflow_tpu.delta import DeltaBatch, Spec, counter_to_batch
+from reflow_tpu.delta import (DeltaBatch, Spec, _hashable,
+                              counter_to_batch)
 
 __all__ = ["Op", "Map", "Filter", "GroupBy", "Reduce", "Join", "Union", "REDUCERS"]
 
@@ -395,8 +396,6 @@ class Join(Op):
         # default join's (va, vb) pairs) pass through untouched.
         v = self.merge(k, _merge_arg(va), _merge_arg(vb))
         if isinstance(v, np.ndarray):
-            from reflow_tpu.delta import _hashable
-
             v = _hashable(v)
         out[(k, v)] += wa * wb
 

@@ -21,6 +21,7 @@ import pytest
 
 from reflow_tpu import DirtyScheduler, FlowGraph
 from reflow_tpu.delta import DeltaBatch, Spec
+from reflow_tpu.executors import CpuExecutor
 from reflow_tpu.executors.tpu import TpuExecutor
 from reflow_tpu.parallel import make_mesh
 from reflow_tpu.parallel.shard import ShardedTpuExecutor
@@ -159,7 +160,7 @@ def test_random_linear_loop_all_programs_agree(seed):
 
     tables = {}
     execs = {
-        "cpu": lambda: None,   # DirtyScheduler default
+        "cpu": lambda: CpuExecutor(),
         "tpu_linear": lambda: TpuExecutor(),
         "tpu_row": lambda: TpuExecutor(linear_fixpoint=False),
         "sharded": lambda: ShardedTpuExecutor(make_mesh(8)),
@@ -167,9 +168,6 @@ def test_random_linear_loop_all_programs_agree(seed):
     for name, mk in execs.items():
         g, base, edges, red, _, _ = fresh()
         ex = mk()
-        if ex is None:
-            from reflow_tpu.executors import CpuExecutor
-            ex = CpuExecutor()
         tables[name] = drive(ex, g, base, edges, red, ticks)
         if name == "tpu_linear":
             assert ex._linear_structure is not None, (
