@@ -60,6 +60,10 @@ def reduce_state(op: Reduce, in_spec: Spec, out_spec: Spec) -> dict:
     vshape = tuple(in_spec.value_shape)
     oshape = tuple(out_spec.value_shape)
     if op.how not in LINEAR_DEVICE_REDUCERS:
+        if vshape == ():
+            # scalar min/max: retraction-capable candidate buffer
+            return minmax_state_scalar(op, K, out_spec.value_dtype)
+        # vector min/max: legacy insert-only elementwise extrema
         init = jnp.inf if op.how == "min" else -jnp.inf
         return {
             "agg": jnp.full((K,) + vshape, init, jnp.float32),
@@ -204,6 +208,173 @@ def _agg_tables(op: Reduce, wsum, wcnt, vdtype):
     return agg, exists
 
 
+def minmax_state_scalar(op: Reduce, K: int, odtype) -> dict:
+    """State for the retraction-capable scalar min/max (candidate buffer).
+
+    Values ride sign-normalized (``sign*v``, sign = +1 for min / -1 for
+    max) so one MIN kernel serves both. ``cand_v``/``cand_w`` hold the R
+    best (smallest normalized) distinct values per key with their
+    multiset weights (any sign: anti-rows are legal transients);
+    ``over_lo`` is a MONOTONE watermark of the smallest value ever
+    evicted and ``over_maybe_pos`` latches whether any positive-net row
+    was ever evicted — together they bound what the buffer can prove:
+    the buffered minimum is global only while strictly below the
+    watermark, and group existence is decidable only while positive
+    support cannot be hiding in the overflow (SURVEY.md §7 hard part c:
+    bounded per-key multisets, loud failure beyond the bound).
+    """
+    R = op.candidates
+    return {
+        "cand_v": jnp.full((K, R), jnp.inf, jnp.float32),
+        "cand_w": jnp.zeros((K, R), jnp.int32),
+        # monotone per-key flags: smallest (normalized) value ever
+        # evicted, and whether any POSITIVE-net row was ever evicted.
+        # Both are conservative one-way latches — overflow rows lose
+        # their identity, so nothing can ever clear them.
+        "over_lo": jnp.full((K,), jnp.inf, jnp.float32),
+        "over_maybe_pos": jnp.zeros((K,), jnp.bool_),
+        "emitted": jnp.zeros((K,), odtype),
+        "emitted_has": jnp.zeros((K,), jnp.bool_),
+        "error": jnp.zeros((), jnp.bool_),
+    }
+
+
+def minmax_scalar_core(op: Reduce, K: int, odtype, state,
+                       d: DeviceDelta, key_offset=0
+                       ) -> Tuple[DeviceDelta, dict]:
+    """One tick of the buffered scalar min/max over a (per-shard) key
+    range; ``d`` carries keys local to ``[0, K)``.
+
+    Algorithm (all shape-static): compact the tick's touched keys into
+    slots, gather their buffers, merge buffer rows + delta rows by
+    (slot, normalized value) with one lexsort, net equal values' weights,
+    keep the R best nonzero rows per slot (rank by running count), evict
+    the rest into ``over_w``/``over_lo``, scatter the rebuilt buffers
+    back. Exactness: the buffer's best positive entry is the true
+    extremum iff it does not exceed ``over_lo`` (everything ever evicted
+    was no better than the buffer's worst AT EVICTION TIME, but later
+    retractions can hollow the buffer past that point — then the answer
+    is unknowable from bounded state and the sticky error raises).
+    Negative-weight entries (retractions of evicted or not-yet-inserted
+    values — legal multiset transients) occupy buffer slots as
+    anti-rows and cancel against later inserts.
+    """
+    sign = jnp.float32(1.0 if op.how == "min" else -1.0)
+    R = state["cand_v"].shape[1]
+    C = d.capacity
+    INF = jnp.float32(jnp.inf)
+
+    live = d.weights != 0
+    dval = jnp.where(live, sign * d.values.reshape(C).astype(jnp.float32),
+                     INF)
+
+    # touched keys -> dense slots [0, n_t)
+    skey = jnp.where(live, d.keys, K)
+    order = jnp.argsort(skey)
+    sk = skey[order]
+    prev = jnp.concatenate([jnp.full((1,), -1, sk.dtype), sk[:-1]])
+    first = (sk != prev) & (sk < K)
+    slot_sorted = jnp.cumsum(first.astype(jnp.int32)) - 1
+    # slot -> key
+    tkeys = jnp.full((C,), K, jnp.int32).at[
+        jnp.where(first, slot_sorted, C)].set(sk.astype(jnp.int32),
+                                              mode="drop")
+    # original row -> slot (dead rows -> C)
+    row_slot = jnp.full((C,), C, jnp.int32).at[order].set(
+        jnp.where(sk < K, slot_sorted, C))
+
+    tk_c = jnp.minimum(tkeys, K - 1)
+    tvalid = tkeys < K
+    bw = jnp.where(tvalid[:, None], state["cand_w"][tk_c], 0)    # [C, R]
+    bv = jnp.where(bw != 0, state["cand_v"][tk_c], INF)
+
+    # merged candidate rows: C*R buffer rows + C delta rows
+    slot_b = jnp.where(bw.reshape(-1) != 0,
+                       jnp.repeat(jnp.arange(C, dtype=jnp.int32), R), C)
+    mslot = jnp.concatenate([slot_b, row_slot])
+    mval = jnp.concatenate([bv.reshape(-1), dval])
+    mw = jnp.concatenate([bw.reshape(-1), jnp.where(live, d.weights, 0)])
+    M = mslot.shape[0]
+
+    o2 = jnp.lexsort((mval, mslot))
+    s2, v2, w2 = mslot[o2], mval[o2], mw[o2]
+    pv = jnp.concatenate([jnp.full((1,), -1, s2.dtype), s2[:-1]])
+    pval = jnp.concatenate([jnp.full((1,), -INF), v2[:-1]])
+    first2 = ((s2 != pv) | (v2 != pval)) & (s2 < C)
+    gid = jnp.cumsum(first2.astype(jnp.int32)) - 1
+    gid_c = jnp.where(s2 < C, gid, M - 1)
+    netw = jnp.zeros((M,), jnp.int32).at[gid_c].add(
+        jnp.where(s2 < C, w2, 0))
+    net_here = netw[gid_c]
+    alive = first2 & (net_here != 0)
+
+    # rank among alive rows within each slot
+    ca = jnp.cumsum(alive.astype(jnp.int32))
+    slot_start = (s2 != pv) & (s2 < C)
+    base = jnp.zeros((C + 1,), jnp.int32).at[
+        jnp.where(slot_start, s2, C)].set(ca - alive.astype(jnp.int32),
+                                          mode="drop")
+    rank = ca - 1 - base[jnp.minimum(s2, C)]
+    keep = alive & (rank < R)
+    evict = alive & (rank >= R)
+
+    # rebuilt buffers per slot
+    flat = jnp.where(keep, jnp.minimum(s2, C - 1) * R + rank, C * R)
+    nb_v = jnp.full((C * R + 1,), INF).at[flat].set(
+        v2, mode="drop")[:C * R].reshape(C, R)
+    nb_w = jnp.zeros((C * R + 1,), jnp.int32).at[flat].set(
+        net_here, mode="drop")[:C * R].reshape(C, R)
+
+    # evictions: the value lowers the over_lo watermark; a positive-net
+    # eviction latches over_maybe_pos (both monotone — overflow rows
+    # lose their identity, so these can never be cleared)
+    ev_lo = jnp.full((C + 1,), INF).at[
+        jnp.where(evict, s2, C)].min(v2, mode="drop")[:C]
+    ev_pos = jnp.zeros((C + 1,), jnp.bool_).at[
+        jnp.where(evict & (net_here > 0), s2, C)].set(
+        True, mode="drop")[:C]
+
+    sidx = jnp.where(tvalid, tkeys, K)
+    cand_v = state["cand_v"].at[sidx].set(nb_v, mode="drop")
+    cand_w = state["cand_w"].at[sidx].set(nb_w, mode="drop")
+    over_lo = state["over_lo"].at[sidx].min(ev_lo, mode="drop")
+    over_maybe_pos = state["over_maybe_pos"] | jnp.zeros(
+        (K,), jnp.bool_).at[sidx].set(ev_pos, mode="drop")
+
+    # dense aggregate over the key range. Existence mirrors the host
+    # oracle's any(w > 0) positive-support rule: provable from the
+    # buffer alone unless a positive row was ever evicted. Exactness of
+    # the buffered minimum additionally needs bmin strictly below the
+    # eviction watermark: at equality an evicted ANTI-row at that very
+    # value could cancel the buffered positive support.
+    pos_v = jnp.where(cand_w > 0, cand_v, INF)
+    bmin = jnp.min(pos_v, axis=1)                     # [K], INF = none
+    has_pos = bmin < INF
+    unknown = ((~has_pos & over_maybe_pos)
+               | (has_pos & (bmin >= over_lo)))
+    exists = has_pos
+    error = state["error"] | jnp.any(unknown)
+
+    emitted, em_has = state["emitted"], state["emitted_has"]
+    aggv = jnp.asarray(sign * jnp.where(has_pos, bmin, 0.0), odtype)
+    changed = _differs(aggv, emitted, op.tol)
+    ins_m = exists & ~unknown & (~em_has | changed)
+    ret_m = em_has & ((~exists | changed) & ~unknown)
+    gkeys = key_offset + jnp.arange(K, dtype=jnp.int32)
+    out = DeviceDelta(
+        keys=jnp.concatenate([gkeys, gkeys]),
+        values=jnp.concatenate([emitted, aggv]),
+        weights=jnp.concatenate(
+            [-ret_m.astype(jnp.int32), ins_m.astype(jnp.int32)]),
+    )
+    new_emitted = jnp.where(_bcast_w(ins_m, aggv), aggv, emitted)
+    new_has = jnp.where(ins_m, True,
+                        jnp.where(ret_m & ~exists, False, em_has))
+    return out, {"cand_v": cand_v, "cand_w": cand_w, "over_lo": over_lo,
+                 "over_maybe_pos": over_maybe_pos, "emitted": new_emitted,
+                 "emitted_has": new_has, "error": error}
+
+
 def _lower_reduce_minmax(op: Reduce, node: Node, state, ins
                          ) -> Tuple[DeviceDelta, dict]:
     """Insert-only scatter-extrema path; retractions set the error flag."""
@@ -263,6 +434,10 @@ def _scatter_contribs(d: DeviceDelta, K: int):
 
 def _lower_reduce(op: Reduce, node: Node, state, ins) -> Tuple[DeviceDelta, dict]:
     if op.how not in LINEAR_DEVICE_REDUCERS:
+        if tuple(node.inputs[0].spec.value_shape) == ():
+            (d,) = ins
+            return minmax_scalar_core(op, node.inputs[0].spec.key_space,
+                                      node.spec.value_dtype, state, d)
         return _lower_reduce_minmax(op, node, state, ins)
     (d,) = ins
     in_spec = node.inputs[0].spec

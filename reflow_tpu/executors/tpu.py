@@ -425,9 +425,12 @@ class TpuExecutor(Executor):
     def _error_reason(node: Node) -> str:
         if (node.kind == "op" and node.op.kind == "reduce"
                 and node.op.how in ("min", "max")):
-            return ("a retraction reached a device min/max reducer "
-                    "(insert-only on device); this tick's state is invalid "
-                    "— run retraction-bearing min/max on the CPU executor")
+            return ("device min/max error: retraction churn exhausted a "
+                    "key's candidate buffer (the bounded exactness window "
+                    "— raise Reduce(candidates=...)), or a retraction "
+                    "reached the insert-only vector-valued path; this "
+                    "tick's state is invalid — re-run on the CPU executor "
+                    "or widen the buffer")
         if node.kind == "op" and node.op.kind == "join":
             return ("join sticky error: either the arena overflowed (live "
                     "rows + appends exceeded capacity even after in-program "

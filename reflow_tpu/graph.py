@@ -155,8 +155,9 @@ class FlowGraph:
         return self.add_op(op, [input], name=name)
 
     def reduce(self, input: Node, how: str = "sum", *, tol: float = 0.0,
-               name: Optional[str] = None, spec: Optional[Spec] = None) -> Node:
-        op = Reduce(how, tol=tol, out_spec=spec)
+               name: Optional[str] = None, spec: Optional[Spec] = None,
+               candidates: int = 8) -> Node:
+        op = Reduce(how, tol=tol, out_spec=spec, candidates=candidates)
         return self.add_op(op, [input], name=name)
 
     def join(self, left: Node, right: Node, merge: Optional[Callable] = None,

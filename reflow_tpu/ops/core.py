@@ -233,11 +233,20 @@ class Reduce(Op):
     kind = "reduce"
 
     def __init__(self, how: str = "sum", *, tol: float = 0.0,
-                 out_spec: Optional[Spec] = None):
+                 out_spec: Optional[Spec] = None, candidates: int = 8):
         if how not in REDUCERS:
             raise ValueError(f"unknown reducer {how!r}; have {sorted(REDUCERS)}")
+        if candidates < 1:
+            raise ValueError(f"candidates must be >= 1, got {candidates}")
         self.how = how
         self.tol = tol
+        #: device min/max only: per-key candidate-buffer depth. The device
+        #: path keeps the ``candidates`` best distinct values per key with
+        #: their multiset weights, so retractions stay EXACT until a key's
+        #: churn exceeds the buffer — then a sticky error raises at the
+        #: next sync (loud, never a wrong aggregate). The host oracle is
+        #: always exact. Irrelevant for linear reducers.
+        self.candidates = candidates
         self._out_spec = out_spec
 
     def out_spec(self, in_specs):

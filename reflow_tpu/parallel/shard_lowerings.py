@@ -60,6 +60,14 @@ ROUTE_SLACK = 4
 _MIN_ROUTE_BUDGET = 64
 
 
+def _should_route(n: int, Cl: int) -> bool:
+    """The shared routed-vs-replicated comm policy (Join, scalar min/max):
+    route when the mesh is big enough for all_to_all to beat all_gather
+    AND the per-destination budget is thick enough not to trip on
+    ordinary key randomness."""
+    return n > ROUTE_SLACK and ROUTE_SLACK * Cl >= _MIN_ROUTE_BUDGET * n
+
+
 def route_rows(d: DeviceDelta, axis: str, n: int, Kl: int,
                slack: int = ROUTE_SLACK
                ) -> Tuple[DeviceDelta, jax.Array]:
@@ -169,13 +177,49 @@ def _lower_reduce_sharded(op, node: Node, state, ins, axis: str, n: int
     return out, new_state
 
 
+def _lower_reduce_minmax_scalar_sharded(op, node: Node, state, ins,
+                                        axis: str, n: int
+                                        ) -> Tuple[DeviceDelta, dict]:
+    """Retraction-capable scalar min/max, key-sharded: delta rows reach
+    their key's owner (routed ``all_to_all`` on large meshes, tiled
+    ``all_gather`` + mask on small ones — the Join's comm policy), then
+    the shared candidate-buffer kernel (``minmax_scalar_core``) runs on
+    the owned key slice. Error flags (route overflow, buffer exhaustion)
+    combine with ``pmax``."""
+    from reflow_tpu.executors.lowerings import minmax_scalar_core
+
+    (d,) = ins
+    K = node.inputs[0].spec.key_space
+    Kl = K // n
+    Cl = d.keys.shape[0]
+    base = (jax.lax.axis_index(axis) * Kl).astype(jnp.int32)
+    err = state["error"]
+
+    if _should_route(n, Cl):
+        dl, route_err = route_rows(d, axis, n, Kl)
+        err = err | (jax.lax.pmax(route_err.astype(jnp.int32), axis) > 0)
+    else:
+        g = jax.tree.map(lambda x: jax.lax.all_gather(x, axis, tiled=True),
+                         d)
+        dl = _localize(g, base, Kl)
+
+    core_state = dict(state)
+    core_state["error"] = err
+    out, new_state = minmax_scalar_core(op, Kl, node.spec.value_dtype,
+                                        core_state, dl, key_offset=base)
+    new_state["error"] = (jax.lax.pmax(
+        new_state["error"].astype(jnp.int32), axis) > 0)
+    return out, new_state
+
+
 def _lower_reduce_minmax_sharded(op, node: Node, state, ins, axis: str,
                                  n: int) -> Tuple[DeviceDelta, dict]:
-    """Insert-only scatter-extrema, key-sharded: each shard builds a dense
-    GLOBAL candidate table from its delta slice, one ``pmax``/``pmin``
-    all-reduce combines them, and the owned slice folds into local state.
-    Retractions set the sticky error flag exactly like the single-device
-    path (SURVEY.md §7 hard part c)."""
+    """Insert-only scatter-extrema, key-sharded (VECTOR values — scalar
+    min/max takes the retraction-capable buffered path above): each shard
+    builds a dense GLOBAL candidate table from its delta slice, one
+    ``pmax``/``pmin`` all-reduce combines them, and the owned slice folds
+    into local state. Retractions set the sticky error flag exactly like
+    the single-device path (SURVEY.md §7 hard part c)."""
     (d,) = ins
     K = node.inputs[0].spec.key_space
     Kl = K // n
@@ -266,7 +310,7 @@ def _lower_join_sharded(op, node: Node, state, ins, axis: str, n: int
         if d is None:
             return None
         Cl = d.keys.shape[0]
-        if n > ROUTE_SLACK and ROUTE_SLACK * Cl >= _MIN_ROUTE_BUDGET * n:
+        if _should_route(n, Cl):
             dl, route_err = route_rows(d, axis, n, Kl)
             err = err | (jax.lax.pmax(route_err.astype(jnp.int32), axis) > 0)
             return dl
@@ -423,6 +467,9 @@ def lower_node_sharded(node: Node, state, ins: Sequence[DeviceDelta],
     if kind == "reduce":
         if node.op.how in LINEAR_DEVICE_REDUCERS:
             return _lower_reduce_sharded(node.op, node, state, ins, axis, n)
+        if tuple(node.inputs[0].spec.value_shape) == ():
+            return _lower_reduce_minmax_scalar_sharded(
+                node.op, node, state, ins, axis, n)
         return _lower_reduce_minmax_sharded(node.op, node, state, ins,
                                             axis, n)
     if kind == "join":

@@ -138,22 +138,67 @@ def test_device_minmax_insert_only_matches_cpu():
     assert views["cpu"] == views["tpu"]
 
 
-def test_device_minmax_retraction_flags_error():
+def test_device_minmax_retraction_within_buffer_matches_cpu():
+    """Scalar min/max retraction is EXACT while the per-key candidate
+    buffer covers the churn (SURVEY.md §7 hard part c, bounded form)."""
+    def build():
+        g = FlowGraph("mm")
+        spec = Spec((), np.float32, key_space=32)
+        src = g.source("src", spec)
+        mx = g.reduce(src, "max", name="mx", spec=spec, candidates=8)
+        g.sink(mx, "out")
+        return g, src
+
+    rng = np.random.default_rng(5)
+    inserted = []
+    ticks = []
+    for t in range(4):
+        rows = []
+        for _ in range(20):
+            if inserted and rng.random() < 0.4:
+                k, v = inserted.pop(int(rng.integers(0, len(inserted))))
+                rows.append((k, v, -1))
+            else:
+                k, v = int(rng.integers(0, 32)), round(
+                    float(rng.normal()), 3)
+                rows.append((k, v, 1))
+                inserted.append((k, v))
+        ticks.append(rows)
+    views = {}
+    for name in ("cpu", "tpu"):
+        g, src = build()
+        sched = DirtyScheduler(g, get_executor(name))
+        for rows in ticks:
+            sched.push(src, DeltaBatch(
+                np.array([r[0] for r in rows]),
+                np.array([r[1] for r in rows], np.float32),
+                np.array([r[2] for r in rows])))
+            sched.tick()
+        views[name] = {int(k): round(float(v), 4)
+                       for k, v in sched.view_dict("out").items()}
+    assert views["cpu"] == views["tpu"]
+
+
+def test_device_minmax_buffer_exhaustion_flags_error():
+    """Retraction churn beyond the candidate buffer fails loudly (never a
+    silently wrong extremum): candidates=1, evict one value, then hollow
+    the buffer."""
     g = FlowGraph("mm")
     spec = Spec((), np.float32, key_space=32)
     src = g.source("src", spec)
-    mx = g.reduce(src, "max", name="mx", spec=spec)
+    mx = g.reduce(src, "max", name="mx", spec=spec, candidates=1)
     g.sink(mx, "out")
     sched = DirtyScheduler(g, get_executor("tpu"))
-    sched.push(src, DeltaBatch(np.array([1]), np.ones(1, np.float32)))
-    sched.tick()
-    sched.push(src, DeltaBatch(np.array([1]), np.ones(1, np.float32),
+    sched.push(src, DeltaBatch(np.array([1, 1]),
+                               np.array([2.0, 1.0], np.float32)))
+    sched.tick()    # buffer holds 2.0; 1.0 evicted to overflow
+    sched.push(src, DeltaBatch(np.array([1]), np.array([2.0], np.float32),
                                -np.ones(1, np.int64)))
     # the tick itself fails loudly (scheduler checks the sticky flag), so
     # corrupt deltas never reach sink views
-    with pytest.raises(RuntimeError, match="retraction"):
+    with pytest.raises(RuntimeError, match="min/max"):
         sched.tick()
-    with pytest.raises(RuntimeError, match="retraction"):
+    with pytest.raises(RuntimeError, match="min/max"):
         sched.read_table(mx)
 
 

@@ -10,7 +10,9 @@ multisets must agree.
 Constraints baked into the generator (the same ones the executors
 enforce at bind): scalar f32 values, key_space divisible by the mesh,
 Join left side a Reduce output (unique) with a vectorized merge,
-arena capacities mesh-divisible, no min/max (insert-only on device),
+arena capacities mesh-divisible, min/max with a candidate buffer wide
+enough for the generated churn (scalar min/max retraction is exact
+within the buffer; exhaustion would raise loudly, not mis-answer),
 loop-free (fixpoint differentials live in test_pagerank/test_fixpoint),
 integer-valued floats so sum/count stay exact and only mean introduces
 rounding (compared at 3 decimals).
@@ -65,9 +67,13 @@ def build_random_graph(rng: np.random.Generator):
                 vectorized=True)
             streams.append(node)
         elif kind == "reduce":
-            how = rng.choice(["sum", "count", "mean"])
+            # min/max ride the retraction-capable candidate buffer;
+            # candidates=32 comfortably covers this generator's per-key
+            # churn (a seed that exhausted it would raise, not mis-answer)
+            how = rng.choice(["sum", "count", "mean", "min", "max"])
             node = g.reduce(rng.choice(streams), how,
-                            tol=1e-6 if how != "count" else 0.0)
+                            tol=1e-6 if how in ("sum", "mean") else 0.0,
+                            candidates=32)
             uniques.append(node)
             streams.append(node)   # emissions are themselves a stream
         elif kind == "union":

@@ -226,9 +226,9 @@ def test_sharded_join_routed_path_differential(mesh):
         assert a[k] == b[k], (k, a[k], b[k])
 
 
-def test_sharded_minmax_matches_cpu_insert_only(mesh):
-    """Sharded min/max: scatter-extrema + pmax combine, insert-only; a
-    retraction trips the sticky error like the single-device path."""
+def test_sharded_minmax_matches_cpu(mesh):
+    """Sharded scalar min/max: rows routed to key owners, candidate-buffer
+    kernel per shard — exact under retraction churn within the buffer."""
     K = 64
     spec = Spec((), np.float32, key_space=K)
     for how in ("min", "max"):
@@ -240,21 +240,49 @@ def test_sharded_minmax_matches_cpu_insert_only(mesh):
         g2.sink(g2.reduce(src2, how, name="m"), "out")
         sh = DirtyScheduler(g, ShardedTpuExecutor(mesh))
         cp = DirtyScheduler(g2, CpuExecutor())
-        rng1, rng2 = np.random.default_rng(8), np.random.default_rng(8)
-        for sched, src_n, rng in ((sh, src, rng1), (cp, src2, rng2)):
-            for _ in range(3):
-                n = 96
+        # identical delta sequence on both: inserts + exact retractions
+        rng = np.random.default_rng(8)
+        inserted = []
+        ticks = []
+        for _ in range(3):
+            rows = []
+            for _ in range(96):
+                if inserted and rng.random() < 0.3:
+                    k, v = inserted.pop(int(rng.integers(0, len(inserted))))
+                    rows.append((k, v, -1))
+                else:
+                    k = int(rng.integers(0, K))
+                    v = float(rng.integers(-50, 50))
+                    rows.append((k, v, 1))
+                    inserted.append((k, v))
+            ticks.append(rows)
+        for sched, src_n in ((sh, src), (cp, src2)):
+            for rows in ticks:
                 sched.push(src_n, DeltaBatch(
-                    rng.integers(0, K, n),
-                    rng.integers(-50, 50, n).astype(np.float32),
-                    np.ones(n, np.int64)))
+                    np.array([r[0] for r in rows]),
+                    np.array([r[1] for r in rows], np.float32),
+                    np.array([r[2] for r in rows])))
                 sched.tick()
         a = {int(k): float(v) for k, v in sh.view_dict("out").items()}
         b = {int(k): float(v) for k, v in cp.view_dict("out").items()}
         assert a == b, how
-    # retraction -> sticky error surfaced
-    sh.push(src, DeltaBatch(np.array([1]), np.array([0.0], np.float32),
-                            np.array([-1], np.int64)))
+
+
+def test_sharded_minmax_buffer_exhaustion_flags_error(mesh):
+    """candidates=1 on the mesh: hollowing a key's buffer past its one
+    eviction trips the sticky error through the routed path too."""
+    K = 64
+    spec = Spec((), np.float32, key_space=K)
+    g = FlowGraph("mm1")
+    src = g.source("s", spec)
+    g.sink(g.reduce(src, "max", name="m", candidates=1), "out")
+    sh = DirtyScheduler(g, ShardedTpuExecutor(mesh))
+    sh.push(src, DeltaBatch(np.array([3, 3]),
+                            np.array([2.0, 1.0], np.float32),
+                            np.ones(2, np.int64)))
+    sh.tick()    # buffer [2.0], overflow {1.0}
+    sh.push(src, DeltaBatch(np.array([3]), np.array([2.0], np.float32),
+                            -np.ones(1, np.int64)))
     with pytest.raises(RuntimeError, match="min/max"):
         sh.tick()
 

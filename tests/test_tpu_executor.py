@@ -261,13 +261,16 @@ def test_streaming_deferred_error_surfaces_at_block():
     at ``block()`` (the documented streaming sync point), not never."""
     g = FlowGraph()
     src = g.source("in", Spec((), np.float32, key_space=8))
-    g.reduce(src, "min", name="lo")  # no sink: streaming defers the check
+    # candidates=1: one eviction + retracting the buffered best exhausts
+    # the bounded exactness window -> sticky flag
+    g.reduce(src, "min", name="lo", candidates=1)  # no sink: check defers
     sched = DirtyScheduler(g, get_executor("tpu"))
-    sched.push(src, DeltaBatch(np.array([1]), np.array([3.0], np.float32)))
-    sched.tick(sync=False).block()  # insert only: clean
+    sched.push(src, DeltaBatch(np.array([1, 1]),
+                               np.array([3.0, 5.0], np.float32)))
+    sched.tick(sync=False).block()  # inserts: clean (5.0 evicted to over)
     sched.push(src, DeltaBatch(np.array([1]), np.array([3.0], np.float32),
                                np.array([-1])))
-    res = sched.tick(sync=False)    # retraction -> sticky flag, deferred
+    res = sched.tick(sync=False)    # buffer hollowed -> sticky, deferred
     with pytest.raises(RuntimeError, match="min/max"):
         res.block()
 
