@@ -454,7 +454,13 @@ def cfg4_knn(smoke: bool, log) -> None:
             "REFLOW_BENCH_KNN_SETTLE", 60)), log,
             "drain the corpus preload + absorb ticks before the window")
 
-        # insert-heavy re-index flow (median-of-3 windows, _median_window)
+        # insert-heavy re-index flow (median-of-3 windows, _stream_window).
+        # NOT a macro-tick: fusing the 6 ticks into one scan execution was
+        # measured SLOWER here (10-12s vs ~4.7s per window) — the tunnel
+        # runtime timeslices single long executions (the bench.py NOTE),
+        # and with 12MB of upload per tick the scan turns the window into
+        # one giant stretched execution. Per-tick streaming keeps the
+        # uploads pipelined against compute.
         def run_insert_window():
             wall, dwall, results = _stream_window(
                 sched, lambda i: sched.push(kg.docs, insert(per_tick)), 6)

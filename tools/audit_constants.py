@@ -18,7 +18,8 @@ import shutil
 import subprocess
 import sys
 
-WORKLOADS = ("pagerank", "tfidf", "knn", "image_embed", "sharded_pagerank")
+WORKLOADS = ("pagerank", "tfidf", "knn", "image_embed", "sharded_pagerank",
+             "minmax")
 
 _CHILD = r'''
 import os, sys
@@ -86,6 +87,24 @@ elif w == "knn":
     sched.tick()
     sched.push(kg.docs, store.retract_batch(np.arange(8)))
     sched.tick()
+elif w == "minmax":
+    from reflow_tpu.delta import DeltaBatch, Spec
+    from reflow_tpu.graph import FlowGraph
+    g = FlowGraph("mm")
+    spec = Spec((), np.float32, key_space=64)
+    s = g.source("s", spec)
+    g.sink(g.reduce(s, "min", name="lo", candidates=8), "out")
+    sched = DirtyScheduler(g, get_executor("tpu"))
+    rng = np.random.default_rng(2)
+    rows = [(int(rng.integers(0, 64)), float(rng.integers(0, 9)), 1)
+            for _ in range(80)]
+    def push(rs):
+        sched.push(s, DeltaBatch(np.array([r[0] for r in rs]),
+                                 np.array([r[1] for r in rs], np.float32),
+                                 np.array([r[2] for r in rs])))
+        sched.tick()
+    push(rows)
+    push([(k, v, -w) for k, v, w in rows[:20]])
 elif w == "image_embed":
     from reflow_tpu.models import VIT_TINY, init_vit
     from reflow_tpu.workloads import image_embed
