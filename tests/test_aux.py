@@ -369,3 +369,49 @@ def test_tick_many_guards():
          {src: wordcount.ingest_lines(["d"])}]).block()
     assert agg.quiesced
     assert dict(sched.view(sink.name)) and agg.deltas_in == 3
+
+
+def test_checkpoint_resume_buffered_minmax(tmp_path):
+    """The candidate-buffer min/max state round-trips through
+    checkpoint/resume — INCLUDING the monotone eviction latches
+    (over_lo / over_maybe_pos): key 1 overflows its candidates=2 buffer
+    before the save, so a post-restore retraction of the buffered best
+    is only safe to refuse if the restored latches carry the eviction
+    history. The restored scheduler must replay both the exact tick and
+    the loud refusal identically."""
+    g = FlowGraph("mm")
+    spec = Spec((), np.float32, key_space=32)
+    src = g.source("src", spec)
+    mx = g.reduce(src, "max", name="mx", spec=spec, candidates=2)
+    g.sink(mx, "out")
+    sched = DirtyScheduler(g, get_executor("tpu"))
+    # key 1: three distinct values -> 3.0 evicted (latches engage);
+    # key 2: within buffer
+    sched.push(src, DeltaBatch(np.array([1, 1, 1, 2]),
+                               np.array([3.0, 5.0, 4.0, 7.0], np.float32)))
+    sched.tick()
+    save_checkpoint(sched, str(tmp_path / "mm"))
+
+    # exact retraction (4.0 stays buffered, 5.0 remains the max)
+    retract_ok = DeltaBatch(np.array([1]), np.array([4.0], np.float32),
+                            -np.ones(1, np.int64))
+    sched.push(src, retract_ok)
+    sched.tick()
+    after = {int(k): float(v) for k, v in sched.read_table(mx).items()}
+    assert after == {1: 5.0, 2: 7.0}
+
+    sched2 = DirtyScheduler(g, get_executor("tpu"))
+    load_checkpoint(sched2, str(tmp_path / "mm"))
+    sched2.push(src, retract_ok)
+    sched2.tick()
+    replay = {int(k): float(v) for k, v in sched2.read_table(mx).items()}
+    assert replay == after
+
+    # hollowing the buffer past the eviction watermark must refuse
+    # loudly on the RESTORED scheduler too — only true if the latches
+    # survived the round-trip
+    sched2.push(src, DeltaBatch(np.array([1, 1]),
+                                np.array([5.0, 4.0], np.float32),
+                                -np.ones(2, np.int64)))
+    with pytest.raises(RuntimeError, match="min/max"):
+        sched2.tick()
