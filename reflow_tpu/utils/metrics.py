@@ -34,9 +34,34 @@ class MetricsSummary:
 
 
 def summarize(history: Sequence) -> MetricsSummary:
-    """Aggregate a scheduler's ``history`` (list of TickResult)."""
+    """Aggregate a scheduler's ``history`` (list of TickResult).
+
+    Streaming ticks' scalar fields may still be device-resident (and
+    ``quiesced`` a deferred callable); force each record to host values
+    first — ``block()`` is idempotent and this is a sync point anyway.
+    """
     if not history:
         return MetricsSummary(0, 0, 0.0, 0.0, 0.0, 0.0, 0.0, True)
+    # ONE batched device_get of every device-resident scalar first: the
+    # per-record block() then hits each jax.Array's cached host value
+    # instead of issuing O(ticks x fields) sequential round trips (a
+    # real cost on tunnel-attached runtimes; callable-wrapped parts
+    # stay lazy and are forced by block itself)
+    leaves = []
+    for r in history:
+        for f in (getattr(r, "passes", None), getattr(r, "deltas_in", None),
+                  getattr(r, "deltas_out", None),
+                  getattr(r, "quiesced", None)):
+            parts = f.parts if hasattr(f, "parts") else (f,)
+            leaves += [p for p in parts
+                       if hasattr(p, "dtype") and hasattr(p, "addressable_shards")]
+    if leaves:
+        import jax
+
+        jax.device_get(leaves)
+    for r in history:
+        if hasattr(r, "block"):
+            r.block()
     walls = np.array([r.wall_s for r in history])
     dops = sum(r.delta_ops for r in history)
     return MetricsSummary(

@@ -415,3 +415,16 @@ def test_checkpoint_resume_buffered_minmax(tmp_path):
                                 -np.ones(2, np.int64)))
     with pytest.raises(RuntimeError, match="min/max"):
         sched2.tick()
+
+
+def test_metrics_summary_over_streaming_history():
+    """summarize must force streaming ticks' device-resident scalars
+    (LazyScalar passes/delta_ops, deferred quiesced) before aggregating."""
+    g, src, sink = _wordcountish()
+    sched = DirtyScheduler(g, get_executor("tpu"))
+    for i in range(3):
+        sched.push(src, DeltaBatch(np.array([i]), np.ones(1, np.float32)))
+        sched.tick(sync=False)
+    s = summarize(sched.history)
+    assert s.ticks == 3 and s.quiesced_all
+    assert s.delta_ops > 0 and s.passes_mean >= 1.0
