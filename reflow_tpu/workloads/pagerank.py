@@ -154,6 +154,21 @@ def teleport_batch(n_nodes: int, damping: float = DAMPING) -> DeltaBatch:
     )
 
 
+def ranks_to_array(table: Dict[int, float], n_nodes: int,
+                   damping: float = DAMPING) -> np.ndarray:
+    """Dense rank vector from a ``read_table`` dict.
+
+    Missing keys default to the teleport floor ``1 - damping`` — the exact
+    rank of a node with no in-edges, and the one value a key can hold
+    without ever having been (re-)emitted. The single shared definition
+    keeps every checker (tests, dryrun) agreeing on what absence means.
+    """
+    out = np.full(n_nodes, 1.0 - damping)
+    for k, v in table.items():
+        out[int(k)] = float(v)
+    return out
+
+
 def reference_ranks(web: WebGraph, damping: float = DAMPING,
                     iters: int = 200, tol: float = 1e-8) -> np.ndarray:
     """Dense NumPy power iteration — the independent correctness oracle."""

@@ -30,11 +30,7 @@ def run_pagerank(executor_name, web, churn_ticks=0):
     return ranks, churn_results, sched
 
 
-def as_array(ranks_dict, n):
-    out = np.full(n, 1.0 - pagerank.DAMPING)
-    for k, v in ranks_dict.items():
-        out[int(k)] = float(v)
-    return out
+as_array = pagerank.ranks_to_array
 
 
 def test_pagerank_cpu_matches_numpy_reference():

@@ -80,10 +80,8 @@ def test_sharded_pagerank_matches_single_device(mesh):
     for k in a:
         assert abs(float(a[k]) - float(b[k])) < bound
     # and both match the NumPy oracle on the churned graph
-    arr = np.full(N, 1.0 - pagerank.DAMPING)
-    for k, v in a.items():
-        arr[int(k)] = float(v)
-    np.testing.assert_allclose(arr, ref, atol=5e-4)
+    np.testing.assert_allclose(pagerank.ranks_to_array(a, N), ref,
+                               atol=5e-4)
 
 
 def test_sharded_join_matches_cpu(mesh):
