@@ -94,4 +94,8 @@ def compact_arena(state: dict) -> dict:
     out.update(rkeys=nk, rvals=nv, rw=nw,
                rcount=jnp.broadcast_to(ncount, state["rcount"].shape
                                        ).astype(state["rcount"].dtype))
+    if "gen" in state:
+        # compaction reorders rows: bump the generation so any persistent
+        # CSR cache over the old ordering invalidates (linear_fixpoint)
+        out["gen"] = state["gen"] + 1
     return out

@@ -66,6 +66,13 @@ class Executor(abc.ABC):
         """Convert a (possibly device-resident) sink egress batch to host."""
         return batch
 
+    def on_states_replaced(self) -> None:
+        """Hook: the caller swapped ``self.states`` wholesale (checkpoint
+        restore). Executors holding derived caches keyed to state content
+        (e.g. the linear fixpoint's sorted-arena CSR) must invalidate
+        them here — the (gen, rcount) validity predicate cannot detect a
+        lineage swap whose counters happen to line up."""
+
     def check_errors(self) -> None:
         """Raise if any op state carries a sticky error flag (called by the
         scheduler once per tick, so invalid state fails loudly instead of

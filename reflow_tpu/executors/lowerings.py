@@ -92,6 +92,11 @@ def join_state(op: Join, left_spec: Spec, right_spec: Spec) -> dict:
                            right_spec.value_dtype),
         "rw": jnp.zeros((R,), jnp.int32),
         "rcount": jnp.zeros((), jnp.int32),
+        # arena generation: bumped by every compaction (which reorders
+        # rows). The linear fixpoint's persistent CSR cache keys its
+        # validity on (gen, rcount): a gen mismatch means the base
+        # ordering is gone and the CSR must rebuild.
+        "gen": jnp.zeros((), jnp.int32),
         # sticky: set when an append overflows the arena even after the
         # in-program compaction pass (checked loudly at the next sync)
         "error": jnp.zeros((), jnp.bool_),
@@ -353,7 +358,13 @@ def minmax_scalar_core(op: Reduce, K: int, odtype, state,
     unknown = ((~has_pos & over_maybe_pos)
                | (has_pos & (bmin >= over_lo)))
     exists = has_pos
-    error = state["error"] | jnp.any(unknown)
+    # cand_w accumulates per-(key, value) net weights ACROSS ticks with
+    # only the per-batch 2**24 mass guard upstream (check_weight_mass);
+    # sustained re-insertion of one value could wrap int32 silently and
+    # flip existence/min decisions (ADVICE r3). Latch loudly at 2**30 —
+    # far below wrap, with room for any single legal batch on top.
+    w_over = jnp.any(jnp.abs(nb_w) > (1 << 30))
+    error = state["error"] | jnp.any(unknown) | w_over
 
     emitted, em_has = state["emitted"], state["emitted_has"]
     aggv = jnp.asarray(sign * jnp.where(has_pos, bmin, 0.0), odtype)
@@ -585,7 +596,7 @@ def join_core(op: Join, K: int, R: int, odtype, state,
         liveb = wb != 0
         n_app = jnp.sum(liveb.astype(jnp.int32))
         arena = {"rkeys": ak, "rvals": av, "rw": aw,
-                 "rcount": state["rcount"]}
+                 "rcount": state["rcount"], "gen": state["gen"]}
         arena = jax.lax.cond(arena["rcount"] + n_app > R,
                              compact_arena, lambda s: s, arena)
         rank = jnp.cumsum(liveb.astype(jnp.int32)) - 1
@@ -594,7 +605,10 @@ def join_core(op: Join, K: int, R: int, odtype, state,
         rvals = arena["rvals"].at[pos].set(vb, mode="drop")
         rw = arena["rw"].at[pos].set(wb, mode="drop")
         rcount = arena["rcount"] + n_app
+        gen = arena["gen"]
         err = err | (rcount > R)
+    else:
+        gen = state["gen"]
 
     out = DeviceDelta(
         jnp.concatenate([o.keys for o in outs]),
@@ -602,7 +616,7 @@ def join_core(op: Join, K: int, R: int, odtype, state,
         jnp.concatenate([o.weights for o in outs]),
     )
     new_state = {"lval": lval, "lw": lw, "rkeys": rkeys, "rvals": rvals,
-                 "rw": rw, "rcount": rcount, "error": err}
+                 "rw": rw, "rcount": rcount, "gen": gen, "error": err}
     return out, new_state
 
 

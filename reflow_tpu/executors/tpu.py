@@ -54,6 +54,11 @@ class TpuExecutor(Executor):
         #: one shard_map region — see linear_fixpoint.py)
         self._linear_fixpoint = linear_fixpoint
         self._linear_structure = None
+        #: ONE persistent sorted-arena CSR cache per join node, shared by
+        #: every LinearFixpointProgram signature over that join (a
+        #: per-program copy would duplicate tens of MB of HBM per ingress
+        #: bucket and re-sort appends the other signature already covered)
+        self._csr_cache: Dict[int, dict] = {}
 
     # -- bind: validate lowerability, build device state -------------------
 
@@ -67,6 +72,10 @@ class TpuExecutor(Executor):
             self._fx_unsupported = not self.fixpoint
             self._linear_structure = None
             self._linear_fixpoint = self.linear_fixpoint
+        # state is reset below: any sorted-arena cache is now stale (the
+        # (gen, rcount) predicate would also catch this via count > rcount,
+        # but an explicit drop is cheaper than relying on it)
+        self._csr_cache.clear()
         self.graph = graph
         self.states = {}
         for node in graph.nodes:
@@ -406,6 +415,14 @@ class TpuExecutor(Executor):
             raise GraphError(f"{node} holds no params state")
         self.states[node.id] = {
             "params": jax.tree.map(lambda x: jnp.array(x, copy=True), params)}
+
+    def on_states_replaced(self) -> None:
+        """Checkpoint restore swapped the state tree: drop the sorted-arena
+        CSR caches. The (gen, rcount) validity predicate cannot detect a
+        lineage swap whose counters line up (two histories can share a
+        (gen, rcount) pair over different arena contents), so restore must
+        invalidate explicitly — the next loop tick rebuilds in-program."""
+        self._csr_cache.clear()
 
     def check_errors(self) -> None:
         # one batched device_get for all sticky flags: every join and

@@ -150,8 +150,10 @@ class FlowGraph:
 
     def group_by(self, input: Node, key_fn: Callable,
                  value_fn: Optional[Callable] = None, *, vectorized: bool = False,
-                 name: Optional[str] = None, spec: Optional[Spec] = None) -> Node:
-        op = GroupBy(key_fn, value_fn, vectorized=vectorized, out_spec=spec)
+                 name: Optional[str] = None, spec: Optional[Spec] = None,
+                 stable_key: bool = False) -> Node:
+        op = GroupBy(key_fn, value_fn, vectorized=vectorized, out_spec=spec,
+                     stable_key=stable_key)
         return self.add_op(op, [input], name=name)
 
     def reduce(self, input: Node, how: str = "sum", *, tol: float = 0.0,

@@ -130,11 +130,21 @@ class GroupBy(Op):
     kind = "groupby"
 
     def __init__(self, key_fn: Callable, value_fn: Optional[Callable] = None,
-                 *, vectorized: bool = False, out_spec: Optional[Spec] = None):
+                 *, vectorized: bool = False, out_spec: Optional[Spec] = None,
+                 stable_key: bool = False):
         self.key_fn = key_fn
         self.value_fn = value_fn
         self.vectorized = vectorized
         self._out_spec = out_spec
+        #: DECLARATION (unchecked contract): inside a declared-linear loop
+        #: region, ``key_fn``'s output does not depend on the loop/left
+        #: value — only on the input key and the right-side (arena) value
+        #: components of the merged row (e.g. PageRank's dst, read from
+        #: the edge). The fused fixpoint then precomputes each arena
+        #: row's destination at CSR-build time and runs its dense tier as
+        #: a destination-SORTED segment sum instead of a random
+        #: scatter-add (~30% cheaper at 1M rows, measured v5e).
+        self.stable_key = stable_key
 
     def out_spec(self, in_specs):
         if self._out_spec is not None:
@@ -337,14 +347,18 @@ def _close(a, b, tol: float) -> bool:
 
 
 def _merge_arg(v):
-    """Host-boundary form of a join value handed to ``merge``: numeric
-    tuples become f64 arrays (the array-like contract); anything else —
-    scalars, strings, nested host-only tuples — passes through."""
-    if isinstance(v, tuple):
-        try:
-            return np.asarray(v, np.float64)
-        except (ValueError, TypeError):
-            return v
+    """Host-boundary form of a join value handed to ``merge``: FLAT tuples
+    of numeric scalars become 1-D f64 arrays (the array-like contract);
+    anything else — scalars, strings, arrays, and ANY nested tuple —
+    passes through unchanged. The flatness test is explicit (ADVICE r3):
+    ``np.asarray`` would silently coerce a rectangular numeric nest (e.g.
+    a default join's ``(va, vb)`` pair of equal-length vectors) into a
+    2-D array, handing a downstream custom merge a different shape than
+    the nested-tuple contract documents."""
+    if isinstance(v, tuple) and all(
+            isinstance(x, (int, float, bool, np.number, np.bool_))
+            for x in v):
+        return np.asarray(v, np.float64)
     return v
 
 

@@ -99,10 +99,12 @@ class ShardedTpuExecutor(TpuExecutor):
                     raise GraphError(
                         f"{node}: arena_capacity {node.op.arena_capacity} "
                         f"must be a multiple of the mesh size {n}")
-                # per-shard append counters (one scalar per mesh slot) +
-                # the sticky route-overflow flag (large meshes route both
-                # delta sides to key owners via all_to_all)
+                # per-shard append counters and arena generations (one
+                # scalar per mesh slot) + the sticky route-overflow flag
+                # (large meshes route both delta sides to key owners via
+                # all_to_all)
                 self.states[node.id]["rcount"] = jnp.zeros((n,), jnp.int32)
+                self.states[node.id]["gen"] = jnp.zeros((n,), jnp.int32)
                 self.states[node.id]["error"] = jnp.zeros((), jnp.bool_)
         # placement derives from the SAME per-leaf specs shard_map uses
         # (one source of truth: _state_tree_specs), so the bound layout

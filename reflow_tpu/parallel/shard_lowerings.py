@@ -320,13 +320,15 @@ def _lower_join_sharded(op, node: Node, state, ins, axis: str, n: int
     da_l = _route(da)
     db_l = _route(db)
 
-    # per-shard scalar append counter is stored as a length-1 slice of a
-    # mesh-length vector; the core kernel wants a scalar
+    # per-shard scalar append counter / arena generation are stored as
+    # length-1 slices of mesh-length vectors; the core kernel wants scalars
     core_state = dict(state)
     core_state["rcount"] = state["rcount"][0]
+    core_state["gen"] = state["gen"][0]
     out, new_state = join_core(op, Kl, Rl, node.spec.value_dtype,
                                core_state, da_l, db_l, key_offset=base)
     new_state["rcount"] = new_state["rcount"][None]
+    new_state["gen"] = new_state["gen"][None]
     # join_core's arena-overflow flag is per-shard; the state leaf is
     # replicated, so fold it with pmax before OR-ing the route error in
     new_state["error"] = err | (jax.lax.pmax(

@@ -99,4 +99,8 @@ def load_checkpoint(sched, path: str) -> None:
     # arena occupancy (rcount) and the sticky overflow flag travel inside
     # the checkpointed state pytree itself; the in-program high-water
     # check (lax.cond compaction in join_core) needs no host-side tracker
-    # reconstruction after restore
+    # reconstruction after restore. Derived caches keyed to state content
+    # (the linear fixpoint's sorted-arena CSR) must drop, though: two
+    # lineages can share a (gen, rcount) pair over different arena rows,
+    # so the in-program validity predicate alone cannot see the swap.
+    sched.executor.on_states_replaced()

@@ -75,7 +75,11 @@ def build_graph(n_nodes: int, *, damping: float = DAMPING, tol: float = 1e-4,
     )
     by_dst = g.group_by(
         j, key_fn=lambda k, v: v[0], value_fn=lambda k, v: v[1],
-        spec=scalar, name="by_dst")
+        spec=scalar, name="by_dst",
+        # the grouping key is the edge's dst — a pure arena-value read,
+        # independent of the rank flowing on the loop: the fused fixpoint
+        # may run its dense tier destination-sorted
+        stable_key=True)
     damped = g.map(by_dst, lambda v: damping * v, vectorized=True,
                    linear=True, name="damp")
     everything = g.union(teleport, damped, name="teleport_plus_contribs")

@@ -47,6 +47,10 @@ def build_linear_loop(rng: np.random.Generator):
     scalar = Spec((), np.float32, key_space=K)
     edge2 = Spec((2,), np.float32, key_space=K)
     use_groupby = bool(rng.random() < 0.7)
+    # the grammar's key_fn reads only the arena value (v[:, 0]), so the
+    # stable_key declaration is always legal here; drawing it randomly
+    # covers both dense tiers (raw scatter vs destination-sorted)
+    stable = bool(rng.random() < 0.5)
     n_maps = int(rng.integers(0, 3))
     map_cs = [int(rng.integers(1, 3)) for _ in range(n_maps)]
 
@@ -59,7 +63,7 @@ def build_linear_loop(rng: np.random.Generator):
                    linear_left=True, arena_capacity=1 << 13)
         node = g.group_by(j, key_fn=lambda k, v: v[:, 0].astype("int32"),
                           value_fn=lambda k, v: v[:, 1],
-                          vectorized=True, spec=scalar)
+                          vectorized=True, spec=scalar, stable_key=stable)
     else:
         # per-key decay: x'[k] = base[k] + coef_sum[k] * x[k]
         node = g.join(x, edges, merge=lambda k, xa, vb: xa * vb,

@@ -168,3 +168,36 @@ def test_in_place_doc_update_matches_oracle():
     table = _ids_table(sched, kg)
     for q in range(Q):
         np.testing.assert_array_equal(table[q], ref_ids[q])
+
+
+def test_device_retraction_never_consults_values():
+    """ADVICE r3: bench config 4 fabricates ZERO-valued retraction rows,
+    relying on the device lowering's contract that a doc retraction only
+    clears the live bit (lowerings._fold_vectors) and never reads the
+    row's value. Pin that contract: retracting with garbage (NaN) values
+    must behave exactly like retracting with the true vectors."""
+    ex_true = get_executor("tpu")
+    ex_junk = get_executor("tpu")
+    tables = []
+    for ex, junk in ((ex_true, False), (ex_junk, True)):
+        kg = knn.build_graph(Q, D, DIM, K, scan_chunk=D)
+        sched = DirtyScheduler(kg.graph, ex)
+        store = knn.EmbeddingStore.create(DIM, seed=9)
+        rng = np.random.default_rng(42)
+        qvecs = rng.normal(size=(Q, DIM)).astype(np.float32)
+        sched.push(kg.queries, DeltaBatch(np.arange(Q), qvecs))
+        sched.push(kg.docs, store.insert_batch(np.arange(0, 96)))
+        sched.tick()
+        ids = np.arange(16, 48)
+        if junk:
+            vals = np.full((len(ids), DIM), np.nan, np.float32)
+            batch = DeltaBatch(ids, vals, -np.ones(len(ids), np.int64))
+        else:
+            batch = store.retract_batch(ids)
+        sched.push(kg.docs, batch)
+        sched.tick()
+        tables.append(sched.read_table(kg.index))
+    a, b = tables
+    assert set(a) == set(b)
+    for q in a:
+        np.testing.assert_array_equal(np.asarray(a[q]), np.asarray(b[q]))
