@@ -66,6 +66,13 @@ class Executor(abc.ABC):
         """Convert a (possibly device-resident) sink egress batch to host."""
         return batch
 
+    def refresh_minmax(self, node: Node, batch: DeltaBatch) -> None:
+        """Maintenance hook for bounded min/max state (no-op by default):
+        rebuild the candidate buffers of every key in ``batch`` from a
+        replay of its full live multiset, resetting the monotone
+        overflow latches. The CPU oracle keeps exact multisets and needs
+        no refresh; device executors override."""
+
     def on_states_replaced(self) -> None:
         """Hook: the caller swapped ``self.states`` wholesale (checkpoint
         restore). Executors holding derived caches keyed to state content

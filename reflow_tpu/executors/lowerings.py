@@ -23,11 +23,13 @@ Keyed-state representations:
   its retract/insert halves scattered to dense temp tables so the arena-side
   product is a pure gather (this is the SpMV shape the MXU/VPU wants).
 
-Non-linear reducers (min/max) lower to insert-only scatter-extrema on
-device (see ``_lower_reduce_minmax``): a retraction cannot be undone
-without the full per-key multiset, so it sets a sticky error flag the
-scheduler surfaces after the tick — retraction-bearing min/max belongs on
-the CPU oracle (SURVEY.md §7 hard part c).
+Non-linear reducers (min/max) lower to a bounded per-key candidate buffer
+(``minmax_core``) holding the R lex-best distinct value rows per key with
+their multiset weights: retractions stay EXACT while the answer is
+derivable from the buffer, and cross into a sticky loud error when churn
+exhausts it (SURVEY.md §7 hard part c: bounded per-key multisets, loud
+failure beyond the bound). Scalar and vector values share the kernel —
+rows are ordered lexicographically, the host oracle's tuple order.
 """
 
 from __future__ import annotations
@@ -43,12 +45,13 @@ from reflow_tpu.graph import Node
 from reflow_tpu.ops import Filter, GroupBy, Join, Map, Reduce, Union
 
 __all__ = ["lower_node", "reduce_state", "join_state", "join_core",
-           "knn_state", "DEVICE_REDUCERS"]
+           "knn_state", "minmax_core", "minmax_refresh_core",
+           "DEVICE_REDUCERS"]
 
-#: sum/count/mean lower to linear scatter-adds; min/max lower to scatter
-#: extrema and are INSERT-ONLY on device (a retraction can't be undone
-#: without the full multiset — it sets a sticky per-node error flag that
-#: read_table surfaces; run retraction-heavy min/max on the CPU oracle)
+#: sum/count/mean lower to linear scatter-adds; min/max lower to the
+#: bounded candidate-buffer kernel (retraction-exact within the per-key
+#: buffer, sticky loud error beyond it — raise Reduce(candidates=...) or
+#: run pathological churn on the CPU oracle)
 DEVICE_REDUCERS = ("sum", "count", "mean", "min", "max")
 LINEAR_DEVICE_REDUCERS = ("sum", "count", "mean")
 
@@ -60,18 +63,9 @@ def reduce_state(op: Reduce, in_spec: Spec, out_spec: Spec) -> dict:
     vshape = tuple(in_spec.value_shape)
     oshape = tuple(out_spec.value_shape)
     if op.how not in LINEAR_DEVICE_REDUCERS:
-        if vshape == ():
-            # scalar min/max: retraction-capable candidate buffer
-            return minmax_state_scalar(op, K, out_spec.value_dtype)
-        # vector min/max: legacy insert-only elementwise extrema
-        init = jnp.inf if op.how == "min" else -jnp.inf
-        return {
-            "agg": jnp.full((K,) + vshape, init, jnp.float32),
-            "wcnt": jnp.zeros((K,), jnp.int32),
-            "emitted": jnp.zeros((K,) + oshape, out_spec.value_dtype),
-            "emitted_has": jnp.zeros((K,), jnp.bool_),
-            "error": jnp.zeros((), jnp.bool_),
-        }
+        # min/max, scalar AND vector: retraction-capable candidate buffer
+        # with lexicographic row ordering (the host oracle's tuple order)
+        return minmax_state(op, K, vshape, oshape, out_spec.value_dtype)
     return {
         "wsum": jnp.zeros((K,) + vshape, jnp.float32),
         "wcnt": jnp.zeros((K,), jnp.int32),
@@ -213,64 +207,95 @@ def _agg_tables(op: Reduce, wsum, wcnt, vdtype):
     return agg, exists
 
 
-def minmax_state_scalar(op: Reduce, K: int, odtype) -> dict:
-    """State for the retraction-capable scalar min/max (candidate buffer).
+def _lex_lt(a: jax.Array, b: jax.Array) -> jax.Array:
+    """Lexicographic ``a < b`` over the trailing axis (equal -> False).
+
+    The host oracle's min/max of vector values is the MIN of value
+    TUPLES (ops/core.py ``_agg_min``: Python tuple ordering), so the
+    device path orders candidate rows lexicographically too — NOT
+    elementwise extrema, which would fabricate a vector that is in no
+    row of the multiset.
+    """
+    neq = a != b
+    has = jnp.any(neq, axis=-1)
+    fi = jnp.argmax(neq, axis=-1)
+    av = jnp.take_along_axis(a, fi[..., None], axis=-1)[..., 0]
+    bv = jnp.take_along_axis(b, fi[..., None], axis=-1)[..., 0]
+    return jnp.where(has, av < bv, False)
+
+
+def minmax_state(op: Reduce, K: int, in_vshape, out_vshape, odtype) -> dict:
+    """State for the retraction-capable min/max (candidate buffer),
+    scalar and vector values alike (a scalar is the V=1 row case).
 
     Values ride sign-normalized (``sign*v``, sign = +1 for min / -1 for
-    max) so one MIN kernel serves both. ``cand_v``/``cand_w`` hold the R
-    best (smallest normalized) distinct values per key with their
-    multiset weights (any sign: anti-rows are legal transients);
-    ``over_lo`` is a MONOTONE watermark of the smallest value ever
-    evicted and ``over_maybe_pos`` latches whether any positive-net row
-    was ever evicted — together they bound what the buffer can prove:
-    the buffered minimum is global only while strictly below the
-    watermark, and group existence is decidable only while positive
-    support cannot be hiding in the overflow (SURVEY.md §7 hard part c:
-    bounded per-key multisets, loud failure beyond the bound).
+    max) so one lex-MIN kernel serves both. ``cand_v``/``cand_w`` hold
+    the R lex-smallest (normalized) distinct value ROWS per key with
+    their multiset weights (any sign: anti-rows are legal transients),
+    stored in ascending lex order — the kernel's rank-ordered rebuild
+    maintains that invariant. ``over_lo`` is a MONOTONE watermark row:
+    the lex-smallest value ever evicted; ``over_maybe_pos`` latches
+    whether any positive-net row was ever evicted. Together they bound
+    what the buffer can prove: the buffered minimum is global only while
+    strictly lex-below the watermark, and group existence is decidable
+    only while positive support cannot be hiding in the overflow
+    (SURVEY.md §7 hard part c: bounded per-key multisets, loud failure
+    beyond the bound). Buffer memory is K x R x V floats — the device
+    path is meant for modest V; huge-vector extrema belong on the CPU
+    oracle.
     """
     R = op.candidates
+    V = 1
+    for s in in_vshape:
+        V *= s
     return {
-        "cand_v": jnp.full((K, R), jnp.inf, jnp.float32),
+        "cand_v": jnp.full((K, R, V), jnp.inf, jnp.float32),
         "cand_w": jnp.zeros((K, R), jnp.int32),
-        # monotone per-key flags: smallest (normalized) value ever
-        # evicted, and whether any POSITIVE-net row was ever evicted.
-        # Both are conservative one-way latches — overflow rows lose
-        # their identity, so nothing can ever clear them.
-        "over_lo": jnp.full((K,), jnp.inf, jnp.float32),
+        # monotone per-key latches — overflow rows lose their identity,
+        # so nothing can ever clear them (see utils refresh for the
+        # host-triggered reset path)
+        "over_lo": jnp.full((K, V), jnp.inf, jnp.float32),
         "over_maybe_pos": jnp.zeros((K,), jnp.bool_),
-        "emitted": jnp.zeros((K,), odtype),
+        "emitted": jnp.zeros((K,) + tuple(out_vshape), odtype),
         "emitted_has": jnp.zeros((K,), jnp.bool_),
         "error": jnp.zeros((), jnp.bool_),
     }
 
 
-def minmax_scalar_core(op: Reduce, K: int, odtype, state,
-                       d: DeviceDelta, key_offset=0
-                       ) -> Tuple[DeviceDelta, dict]:
-    """One tick of the buffered scalar min/max over a (per-shard) key
-    range; ``d`` carries keys local to ``[0, K)``.
+def minmax_core(op: Reduce, K: int, out_vshape, odtype, state,
+                d: DeviceDelta, key_offset=0
+                ) -> Tuple[DeviceDelta, dict]:
+    """One tick of the buffered min/max over a (per-shard) key range;
+    ``d`` carries keys local to ``[0, K)``. Scalar and VECTOR values
+    share this kernel: a candidate is a distinct value ROW [V], ordered
+    lexicographically (the host oracle's tuple ordering), and a scalar
+    is simply V=1.
 
     Algorithm (all shape-static): compact the tick's touched keys into
     slots, gather their buffers, merge buffer rows + delta rows by
-    (slot, normalized value) with one lexsort, net equal values' weights,
-    keep the R best nonzero rows per slot (rank by running count), evict
-    the rest into ``over_w``/``over_lo``, scatter the rebuilt buffers
-    back. Exactness: the buffer's best positive entry is the true
-    extremum iff it does not exceed ``over_lo`` (everything ever evicted
-    was no better than the buffer's worst AT EVICTION TIME, but later
-    retractions can hollow the buffer past that point — then the answer
-    is unknowable from bounded state and the sticky error raises).
-    Negative-weight entries (retractions of evicted or not-yet-inserted
-    values — legal multiset transients) occupy buffer slots as
-    anti-rows and cancel against later inserts.
+    (slot, normalized value row) with one multi-column lexsort, net
+    bit-equal rows' weights, keep the R lex-best nonzero rows per slot
+    (rank by running count — the buffer therefore stays rank-SORTED,
+    which is what lets the aggregate read the first positive rank),
+    evict the rest into the ``over_lo``/``over_maybe_pos`` latches,
+    scatter the rebuilt buffers back. Exactness: the buffer's first
+    positive entry is the true extremum iff it is strictly lex-below
+    ``over_lo`` (everything ever evicted was no better than the buffer's
+    worst AT EVICTION TIME, but later retractions can hollow the buffer
+    past that point — then the answer is unknowable from bounded state
+    and the sticky error raises). Negative-weight entries (retractions
+    of evicted or not-yet-inserted values — legal multiset transients)
+    occupy buffer slots as anti-rows and cancel against later inserts.
     """
     sign = jnp.float32(1.0 if op.how == "min" else -1.0)
     R = state["cand_v"].shape[1]
+    V = state["cand_v"].shape[2]
     C = d.capacity
     INF = jnp.float32(jnp.inf)
 
     live = d.weights != 0
-    dval = jnp.where(live, sign * d.values.reshape(C).astype(jnp.float32),
+    dval = jnp.where(live[:, None],
+                     sign * d.values.reshape(C, V).astype(jnp.float32),
                      INF)
 
     # touched keys -> dense slots [0, n_t)
@@ -291,21 +316,24 @@ def minmax_scalar_core(op: Reduce, K: int, odtype, state,
     tk_c = jnp.minimum(tkeys, K - 1)
     tvalid = tkeys < K
     bw = jnp.where(tvalid[:, None], state["cand_w"][tk_c], 0)    # [C, R]
-    bv = jnp.where(bw != 0, state["cand_v"][tk_c], INF)
+    bv = jnp.where((bw != 0)[:, :, None], state["cand_v"][tk_c], INF)
 
     # merged candidate rows: C*R buffer rows + C delta rows
     slot_b = jnp.where(bw.reshape(-1) != 0,
                        jnp.repeat(jnp.arange(C, dtype=jnp.int32), R), C)
     mslot = jnp.concatenate([slot_b, row_slot])
-    mval = jnp.concatenate([bv.reshape(-1), dval])
+    mval = jnp.concatenate([bv.reshape(C * R, V), dval])         # [M, V]
     mw = jnp.concatenate([bw.reshape(-1), jnp.where(live, d.weights, 0)])
     M = mslot.shape[0]
 
-    o2 = jnp.lexsort((mval, mslot))
+    # lex order: slot primary, then value columns (np.lexsort: LAST key
+    # is primary)
+    o2 = jnp.lexsort(tuple(mval[:, q] for q in range(V - 1, -1, -1))
+                     + (mslot,))
     s2, v2, w2 = mslot[o2], mval[o2], mw[o2]
     pv = jnp.concatenate([jnp.full((1,), -1, s2.dtype), s2[:-1]])
-    pval = jnp.concatenate([jnp.full((1,), -INF), v2[:-1]])
-    first2 = ((s2 != pv) | (v2 != pval)) & (s2 < C)
+    pval = jnp.concatenate([jnp.full((1, V), -INF), v2[:-1]])
+    first2 = ((s2 != pv) | jnp.any(v2 != pval, axis=1)) & (s2 < C)
     gid = jnp.cumsum(first2.astype(jnp.int32)) - 1
     gid_c = jnp.where(s2 < C, gid, M - 1)
     netw = jnp.zeros((M,), jnp.int32).at[gid_c].add(
@@ -323,18 +351,21 @@ def minmax_scalar_core(op: Reduce, K: int, odtype, state,
     keep = alive & (rank < R)
     evict = alive & (rank >= R)
 
-    # rebuilt buffers per slot
+    # rebuilt buffers per slot (rank-ordered: ascending lex)
     flat = jnp.where(keep, jnp.minimum(s2, C - 1) * R + rank, C * R)
-    nb_v = jnp.full((C * R + 1,), INF).at[flat].set(
-        v2, mode="drop")[:C * R].reshape(C, R)
+    nb_v = jnp.full((C * R + 1, V), INF).at[flat].set(
+        v2, mode="drop")[:C * R].reshape(C, R, V)
     nb_w = jnp.zeros((C * R + 1,), jnp.int32).at[flat].set(
         net_here, mode="drop")[:C * R].reshape(C, R)
 
-    # evictions: the value lowers the over_lo watermark; a positive-net
-    # eviction latches over_maybe_pos (both monotone — overflow rows
-    # lose their identity, so these can never be cleared)
-    ev_lo = jnp.full((C + 1,), INF).at[
-        jnp.where(evict, s2, C)].min(v2, mode="drop")[:C]
+    # evictions: the slot's FIRST evicted row (rank == R) is the
+    # lex-smallest evicted (rows are sorted), and it lowers the over_lo
+    # watermark; a positive-net eviction latches over_maybe_pos (both
+    # monotone — overflow rows lose their identity, so these can never
+    # be cleared)
+    first_ev = evict & (rank == R)
+    ev_lo = jnp.full((C + 1, V), INF).at[
+        jnp.where(first_ev, s2, C)].set(v2, mode="drop")[:C]
     ev_pos = jnp.zeros((C + 1,), jnp.bool_).at[
         jnp.where(evict & (net_here > 0), s2, C)].set(
         True, mode="drop")[:C]
@@ -342,21 +373,25 @@ def minmax_scalar_core(op: Reduce, K: int, odtype, state,
     sidx = jnp.where(tvalid, tkeys, K)
     cand_v = state["cand_v"].at[sidx].set(nb_v, mode="drop")
     cand_w = state["cand_w"].at[sidx].set(nb_w, mode="drop")
-    over_lo = state["over_lo"].at[sidx].min(ev_lo, mode="drop")
+    lo_g = jnp.where(tvalid[:, None], state["over_lo"][tk_c], INF)
+    new_lo = jnp.where(_lex_lt(ev_lo, lo_g)[:, None], ev_lo, lo_g)
+    over_lo = state["over_lo"].at[sidx].set(new_lo, mode="drop")
     over_maybe_pos = state["over_maybe_pos"] | jnp.zeros(
         (K,), jnp.bool_).at[sidx].set(ev_pos, mode="drop")
 
     # dense aggregate over the key range. Existence mirrors the host
     # oracle's any(w > 0) positive-support rule: provable from the
     # buffer alone unless a positive row was ever evicted. Exactness of
-    # the buffered minimum additionally needs bmin strictly below the
-    # eviction watermark: at equality an evicted ANTI-row at that very
-    # value could cancel the buffered positive support.
-    pos_v = jnp.where(cand_w > 0, cand_v, INF)
-    bmin = jnp.min(pos_v, axis=1)                     # [K], INF = none
-    has_pos = bmin < INF
+    # the buffered minimum additionally needs bmin strictly lex-below
+    # the eviction watermark: at equality an evicted ANTI-row at that
+    # very value could cancel the buffered positive support.
+    pos = cand_w > 0                                  # [K, R]
+    has_pos = jnp.any(pos, axis=1)
+    fi = jnp.argmax(pos, axis=1)
+    bmin = jnp.take_along_axis(cand_v, fi[:, None, None],
+                               axis=1)[:, 0]          # [K, V]
     unknown = ((~has_pos & over_maybe_pos)
-               | (has_pos & (bmin >= over_lo)))
+               | (has_pos & ~_lex_lt(bmin, over_lo)))
     exists = has_pos
     # cand_w accumulates per-(key, value) net weights ACROSS ticks with
     # only the per-batch 2**24 mass guard upstream (check_weight_mass);
@@ -367,7 +402,8 @@ def minmax_scalar_core(op: Reduce, K: int, odtype, state,
     error = state["error"] | jnp.any(unknown) | w_over
 
     emitted, em_has = state["emitted"], state["emitted_has"]
-    aggv = jnp.asarray(sign * jnp.where(has_pos, bmin, 0.0), odtype)
+    agg_rows = sign * jnp.where(has_pos[:, None], bmin, 0.0)
+    aggv = jnp.asarray(agg_rows.reshape((K,) + tuple(out_vshape)), odtype)
     changed = _differs(aggv, emitted, op.tol)
     ins_m = exists & ~unknown & (~em_has | changed)
     ret_m = em_has & ((~exists | changed) & ~unknown)
@@ -383,43 +419,6 @@ def minmax_scalar_core(op: Reduce, K: int, odtype, state,
                         jnp.where(ret_m & ~exists, False, em_has))
     return out, {"cand_v": cand_v, "cand_w": cand_w, "over_lo": over_lo,
                  "over_maybe_pos": over_maybe_pos, "emitted": new_emitted,
-                 "emitted_has": new_has, "error": error}
-
-
-def _lower_reduce_minmax(op: Reduce, node: Node, state, ins
-                         ) -> Tuple[DeviceDelta, dict]:
-    """Insert-only scatter-extrema path; retractions set the error flag."""
-    (d,) = ins
-    K = node.inputs[0].spec.key_space
-    vdtype = node.spec.value_dtype
-    pad = jnp.inf if op.how == "min" else -jnp.inf
-
-    live_keys = jnp.where(d.weights > 0, d.keys, K)
-    vals = jnp.where(_bcast_w(d.weights > 0, d.values),
-                     d.values.astype(jnp.float32), pad)
-    if op.how == "min":
-        agg = state["agg"].at[live_keys].min(vals, mode="drop")
-    else:
-        agg = state["agg"].at[live_keys].max(vals, mode="drop")
-    wcnt = state["wcnt"].at[d.keys].add(d.weights)
-    error = state["error"] | jnp.any(d.weights < 0)
-
-    emitted, em_has = state["emitted"], state["emitted_has"]
-    exists = wcnt > 0
-    aggv = jnp.asarray(agg, vdtype)
-    changed = _differs(aggv, emitted, op.tol)
-    ins_m = exists & (~em_has | changed)
-    ret_m = em_has & (~exists | changed)
-    all_keys = jnp.arange(K, dtype=jnp.int32)
-    out = DeviceDelta(
-        keys=jnp.concatenate([all_keys, all_keys]),
-        values=jnp.concatenate([emitted, aggv]),
-        weights=jnp.concatenate(
-            [-ret_m.astype(jnp.int32), ins_m.astype(jnp.int32)]),
-    )
-    new_emitted = jnp.where(_bcast_w(ins_m, aggv), aggv, emitted)
-    new_has = jnp.where(ins_m, True, jnp.where(ret_m & ~exists, False, em_has))
-    return out, {"agg": agg, "wcnt": wcnt, "emitted": new_emitted,
                  "emitted_has": new_has, "error": error}
 
 
@@ -443,13 +442,45 @@ def _scatter_contribs(d: DeviceDelta, K: int):
     return dws, dwc
 
 
+def minmax_refresh_core(op: Reduce, K: int, out_vshape, odtype, state,
+                        d: DeviceDelta, key_offset=0) -> dict:
+    """Latch REFRESH (ROADMAP r3 #3): rebuild the candidate buffers of
+    every key present in ``d`` from a user-supplied REPLAY of its full
+    live multiset, resetting the monotone ``over_lo``/``over_maybe_pos``
+    latches — the maintenance path that keeps a long-running
+    heavy-churn key exact instead of eventually tripping the loud
+    overflow error.
+
+    Contract: for each key it mentions, ``d`` holds EVERY live row of
+    that key's current collection (one +w row per multiset entry).
+    Because the replay is the same collection the state already
+    aggregates, the emitted aggregate cannot change: a live emission
+    diff out of the replay means the replay contradicts the state
+    (user error, or prior corruption) and sets the sticky error flag
+    instead of silently re-emitting.
+    """
+    live = d.weights != 0
+    touched = jnp.zeros((K,), jnp.bool_).at[
+        jnp.where(live, d.keys, K)].set(True, mode="drop")
+    st = dict(state)
+    tb = touched[:, None]
+    st["cand_v"] = jnp.where(touched[:, None, None], jnp.inf,
+                             state["cand_v"])
+    st["cand_w"] = jnp.where(tb, 0, state["cand_w"])
+    st["over_lo"] = jnp.where(tb, jnp.inf, state["over_lo"])
+    st["over_maybe_pos"] = jnp.where(touched, False,
+                                     state["over_maybe_pos"])
+    out, st2 = minmax_core(op, K, out_vshape, odtype, st, d, key_offset)
+    st2["error"] = st2["error"] | jnp.any(out.weights != 0)
+    return st2
+
+
 def _lower_reduce(op: Reduce, node: Node, state, ins) -> Tuple[DeviceDelta, dict]:
     if op.how not in LINEAR_DEVICE_REDUCERS:
-        if tuple(node.inputs[0].spec.value_shape) == ():
-            (d,) = ins
-            return minmax_scalar_core(op, node.inputs[0].spec.key_space,
-                                      node.spec.value_dtype, state, d)
-        return _lower_reduce_minmax(op, node, state, ins)
+        (d,) = ins
+        return minmax_core(op, node.inputs[0].spec.key_space,
+                           tuple(node.spec.value_shape),
+                           node.spec.value_dtype, state, d)
     (d,) = ins
     in_spec = node.inputs[0].spec
     K = in_spec.key_space

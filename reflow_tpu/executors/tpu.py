@@ -424,6 +424,31 @@ class TpuExecutor(Executor):
         invalidate explicitly — the next loop tick rebuilds in-program."""
         self._csr_cache.clear()
 
+    def refresh_minmax(self, node: Node, batch: DeltaBatch) -> None:
+        """Host-triggered latch refresh for a buffered min/max Reduce
+        (ROADMAP r3 #3): ``batch`` replays the FULL live multiset of
+        every key it mentions; those keys' candidate buffers rebuild
+        from it and the monotone overflow latches reset. Pure
+        maintenance — the aggregate cannot change (a contradicting
+        replay sets the sticky error instead). Call between ticks, from
+        the same host thread that ticks (node validation lives in the
+        scheduler wrapper — the one call site)."""
+        from reflow_tpu.executors.lowerings import minmax_refresh_core
+
+        d = to_device(batch, node.inputs[0].spec)
+        K = node.inputs[0].spec.key_space
+        sig = ("mmrefresh", node.id, d.capacity)
+        fn = self._cache.get(sig)
+        if fn is None:
+            op, oshape, odt = node.op, tuple(node.spec.value_shape), \
+                node.spec.value_dtype
+
+            def refresh_fn(st, dd):
+                return minmax_refresh_core(op, K, oshape, odt, st, dd)
+
+            fn = self._cache[sig] = jax.jit(refresh_fn, donate_argnums=0)
+        self.states[node.id] = fn(self.states[node.id], d)
+
     def check_errors(self) -> None:
         # one batched device_get for all sticky flags: every join and
         # min/max reducer carries an 'error' leaf, and per-leaf bool()
@@ -444,10 +469,9 @@ class TpuExecutor(Executor):
                 and node.op.how in ("min", "max")):
             return ("device min/max error: retraction churn exhausted a "
                     "key's candidate buffer (the bounded exactness window "
-                    "— raise Reduce(candidates=...)), or a retraction "
-                    "reached the insert-only vector-valued path; this "
-                    "tick's state is invalid — re-run on the CPU executor "
-                    "or widen the buffer")
+                    "— raise Reduce(candidates=...)); this tick's state "
+                    "is invalid — re-run on the CPU executor or widen "
+                    "the buffer")
         if node.kind == "op" and node.op.kind == "join":
             return ("join sticky error: either the arena overflowed (live "
                     "rows + appends exceeded capacity even after in-program "

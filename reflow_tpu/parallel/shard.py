@@ -148,6 +148,46 @@ class ShardedTpuExecutor(TpuExecutor):
         super().update_params(node, params)
         self.states[node.id] = replicate(self.states[node.id], self.mesh)
 
+    def refresh_minmax(self, node: Node, batch) -> None:
+        """Sharded latch refresh: replay rows reach their key's owner
+        (the min/max comm policy), then the shared refresh kernel runs
+        per shard on the owned key slice."""
+        from reflow_tpu.executors.device_delta import to_device
+        from reflow_tpu.executors.lowerings import minmax_refresh_core
+        from reflow_tpu.parallel.shard_lowerings import deliver_to_owner
+
+        d = to_device(batch, node.inputs[0].spec)
+        K = node.inputs[0].spec.key_space
+        n, axis, mesh = self.n, self.axis, self.mesh
+        sig = ("mmrefresh", node.id, d.capacity)
+        fn = self._cache.get(sig)
+        if fn is None:
+            op = node.op
+            oshape, odt = tuple(node.spec.value_shape), node.spec.value_dtype
+            Kl = K // n
+
+            def body(st, dd):
+                import jax.numpy as jnp
+                base = (jax.lax.axis_index(axis) * Kl).astype(jnp.int32)
+                dl, route_err = deliver_to_owner(dd, axis, n, Kl)
+                err = st["error"] | route_err
+                st2 = minmax_refresh_core(op, Kl, oshape, odt,
+                                          {**st, "error": err}, dl,
+                                          key_offset=base)
+                st2["error"] = (jax.lax.pmax(
+                    st2["error"].astype(jnp.int32), axis) > 0)
+                return st2
+
+            from jax.sharding import PartitionSpec as P2
+
+            sspec = self._state_tree_specs(
+                {node.id: self.states[node.id]})[node.id]
+            dspec = DeviceDelta(P2(axis), P2(axis), P2(axis))
+            fn = self._cache[sig] = jax.jit(jax.shard_map(
+                body, mesh=mesh, in_specs=(sspec, dspec),
+                out_specs=sspec, check_vma=False), donate_argnums=0)
+        self.states[node.id] = fn(self.states[node.id], d)
+
     # -- the SPMD pass program ---------------------------------------------
 
     def _lower(self, node: Node, state, ins):

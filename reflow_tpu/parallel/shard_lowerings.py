@@ -48,7 +48,8 @@ from reflow_tpu.executors.lowerings import (_LOWERINGS, LINEAR_DEVICE_REDUCERS,
                                             _scatter_contribs, join_core)
 from reflow_tpu.graph import Node
 
-__all__ = ["lower_node_sharded", "route_rows", "ROUTE_SLACK"]
+__all__ = ["lower_node_sharded", "route_rows", "deliver_to_owner",
+           "ROUTE_SLACK"]
 
 #: per-destination row budget = ROUTE_SLACK x the perfectly-balanced
 #: share. 4x absorbs realistic key skew; pathological skew trips the
@@ -61,11 +62,27 @@ _MIN_ROUTE_BUDGET = 64
 
 
 def _should_route(n: int, Cl: int) -> bool:
-    """The shared routed-vs-replicated comm policy (Join, scalar min/max):
+    """The shared routed-vs-replicated comm policy (Join, min/max):
     route when the mesh is big enough for all_to_all to beat all_gather
     AND the per-destination budget is thick enough not to trip on
     ordinary key randomness."""
     return n > ROUTE_SLACK and ROUTE_SLACK * Cl >= _MIN_ROUTE_BUDGET * n
+
+
+def deliver_to_owner(d: DeviceDelta, axis: str, n: int, Kl: int
+                     ) -> Tuple[DeviceDelta, jax.Array]:
+    """Deliver every live row of a row-sharded delta to the shard owning
+    its key range, returning a LOCAL-keyed delta plus the (pmax-combined)
+    route-overflow flag. ONE definition of the routed-vs-replicated
+    policy, shared by every keyed consumer (Reduce, Join, min/max, the
+    latch refresh) so no path can drift to a different policy."""
+    Cl = d.keys.shape[0]
+    if _should_route(n, Cl):
+        dl, route_err = route_rows(d, axis, n, Kl)
+        return dl, jax.lax.pmax(route_err.astype(jnp.int32), axis) > 0
+    base = (jax.lax.axis_index(axis) * Kl).astype(jnp.int32)
+    g = jax.tree.map(lambda x: jax.lax.all_gather(x, axis, tiled=True), d)
+    return _localize(g, base, Kl), jnp.zeros((), jnp.bool_)
 
 
 def route_rows(d: DeviceDelta, axis: str, n: int, Kl: int,
@@ -177,117 +194,32 @@ def _lower_reduce_sharded(op, node: Node, state, ins, axis: str, n: int
     return out, new_state
 
 
-def _lower_reduce_minmax_scalar_sharded(op, node: Node, state, ins,
-                                        axis: str, n: int
-                                        ) -> Tuple[DeviceDelta, dict]:
-    """Retraction-capable scalar min/max, key-sharded: delta rows reach
-    their key's owner (routed ``all_to_all`` on large meshes, tiled
-    ``all_gather`` + mask on small ones — the Join's comm policy), then
-    the shared candidate-buffer kernel (``minmax_scalar_core``) runs on
-    the owned key slice. Error flags (route overflow, buffer exhaustion)
-    combine with ``pmax``."""
-    from reflow_tpu.executors.lowerings import minmax_scalar_core
+def _lower_reduce_minmax_sharded(op, node: Node, state, ins,
+                                 axis: str, n: int
+                                 ) -> Tuple[DeviceDelta, dict]:
+    """Retraction-capable min/max (scalar AND vector rows), key-sharded:
+    delta rows reach their key's owner (routed ``all_to_all`` on large
+    meshes, tiled ``all_gather`` + mask on small ones — the Join's comm
+    policy), then the shared candidate-buffer kernel (``minmax_core``)
+    runs on the owned key slice. Error flags (route overflow, buffer
+    exhaustion) combine with ``pmax``."""
+    from reflow_tpu.executors.lowerings import minmax_core
 
     (d,) = ins
     K = node.inputs[0].spec.key_space
     Kl = K // n
-    Cl = d.keys.shape[0]
     base = (jax.lax.axis_index(axis) * Kl).astype(jnp.int32)
-    err = state["error"]
-
-    if _should_route(n, Cl):
-        dl, route_err = route_rows(d, axis, n, Kl)
-        err = err | (jax.lax.pmax(route_err.astype(jnp.int32), axis) > 0)
-    else:
-        g = jax.tree.map(lambda x: jax.lax.all_gather(x, axis, tiled=True),
-                         d)
-        dl = _localize(g, base, Kl)
+    dl, route_err = deliver_to_owner(d, axis, n, Kl)
+    err = state["error"] | route_err
 
     core_state = dict(state)
     core_state["error"] = err
-    out, new_state = minmax_scalar_core(op, Kl, node.spec.value_dtype,
-                                        core_state, dl, key_offset=base)
+    out, new_state = minmax_core(op, Kl, tuple(node.spec.value_shape),
+                                 node.spec.value_dtype, core_state, dl,
+                                 key_offset=base)
     new_state["error"] = (jax.lax.pmax(
         new_state["error"].astype(jnp.int32), axis) > 0)
     return out, new_state
-
-
-def _lower_reduce_minmax_sharded(op, node: Node, state, ins, axis: str,
-                                 n: int) -> Tuple[DeviceDelta, dict]:
-    """Insert-only scatter-extrema, key-sharded (VECTOR values — scalar
-    min/max takes the retraction-capable buffered path above): each shard
-    builds a dense GLOBAL candidate table from its delta slice, one
-    ``pmax``/``pmin`` all-reduce combines them, and the owned slice folds
-    into local state. Retractions set the sticky error flag exactly like
-    the single-device path (SURVEY.md §7 hard part c)."""
-    (d,) = ins
-    K = node.inputs[0].spec.key_space
-    Kl = K // n
-    Cl = d.keys.shape[0]
-    vdtype = node.spec.value_dtype
-    pad = jnp.inf if op.how == "min" else -jnp.inf
-    base = (jax.lax.axis_index(axis) * Kl).astype(jnp.int32)
-    vshape = d.values.shape[1:]
-
-    # retraction check runs on the pre-route rows (routing may budget-drop)
-    retract = jnp.any(d.weights < 0)
-    error = state["error"] | (jax.lax.pmax(retract.astype(jnp.int32),
-                                           axis) > 0)
-
-    if ROUTE_SLACK * Cl < Kl:
-        # sparse regime: route rows to their owner, take extrema locally —
-        # comms O(slack*Cl), never a dense global-K table
-        dl, route_err = route_rows(d, axis, n, Kl)
-        error = error | (jax.lax.pmax(route_err.astype(jnp.int32),
-                                      axis) > 0)
-        live_keys = jnp.where(dl.weights > 0, dl.keys, Kl)
-        vals = jnp.where(_bcast_w(dl.weights > 0, dl.values),
-                         dl.values.astype(jnp.float32), pad)
-        if op.how == "min":
-            agg = state["agg"].at[live_keys].min(vals, mode="drop")
-        else:
-            agg = state["agg"].at[live_keys].max(vals, mode="drop")
-        # routed keys are already local in [0, Kl); padding rows carry
-        # key 0 / weight 0 and vanish in the add
-        wcnt = state["wcnt"].at[dl.keys].add(dl.weights)
-    else:
-        # dense regime: global-K candidate table + one extrema all-reduce
-        live_keys = jnp.where(d.weights > 0, d.keys, K)
-        vals = jnp.where(_bcast_w(d.weights > 0, d.values),
-                         d.values.astype(jnp.float32), pad)
-        cand = jnp.full((K,) + vshape, pad, jnp.float32)
-        if op.how == "min":
-            cand = cand.at[live_keys].min(vals, mode="drop")
-            cand = -jax.lax.pmax(-cand, axis)
-        else:
-            cand = cand.at[live_keys].max(vals, mode="drop")
-            cand = jax.lax.pmax(cand, axis)
-        own = jax.lax.dynamic_slice_in_dim(cand, base, Kl, 0)
-        agg = (jnp.minimum(state["agg"], own) if op.how == "min"
-               else jnp.maximum(state["agg"], own))
-        dwc = jnp.zeros((K,), jnp.float32).at[d.keys].add(
-            d.weights.astype(jnp.float32))
-        dwc = jax.lax.psum_scatter(dwc, axis, scatter_dimension=0,
-                                   tiled=True)
-        wcnt = state["wcnt"] + dwc.astype(jnp.int32)
-
-    emitted, em_has = state["emitted"], state["emitted_has"]
-    exists = wcnt > 0
-    aggv = jnp.asarray(agg, vdtype)
-    changed = _differs(aggv, emitted, op.tol)
-    ins_m = exists & (~em_has | changed)
-    ret_m = em_has & (~exists | changed)
-    gkeys = base + jnp.arange(Kl, dtype=jnp.int32)
-    out = DeviceDelta(
-        keys=jnp.concatenate([gkeys, gkeys]),
-        values=jnp.concatenate([emitted, aggv]),
-        weights=jnp.concatenate(
-            [-ret_m.astype(jnp.int32), ins_m.astype(jnp.int32)]),
-    )
-    new_emitted = jnp.where(_bcast_w(ins_m, aggv), aggv, emitted)
-    new_has = jnp.where(ins_m, True, jnp.where(ret_m & ~exists, False, em_has))
-    return out, {"agg": agg, "wcnt": wcnt, "emitted": new_emitted,
-                 "emitted_has": new_has, "error": error}
 
 
 def _lower_join_sharded(op, node: Node, state, ins, axis: str, n: int
@@ -309,13 +241,9 @@ def _lower_join_sharded(op, node: Node, state, ins, axis: str, n: int
         nonlocal err
         if d is None:
             return None
-        Cl = d.keys.shape[0]
-        if _should_route(n, Cl):
-            dl, route_err = route_rows(d, axis, n, Kl)
-            err = err | (jax.lax.pmax(route_err.astype(jnp.int32), axis) > 0)
-            return dl
-        g = jax.tree.map(lambda x: jax.lax.all_gather(x, axis, tiled=True), d)
-        return _localize(g, base, Kl)
+        dl, route_err = deliver_to_owner(d, axis, n, Kl)
+        err = err | route_err
+        return dl
 
     da_l = _route(da)
     db_l = _route(db)
@@ -469,9 +397,6 @@ def lower_node_sharded(node: Node, state, ins: Sequence[DeviceDelta],
     if kind == "reduce":
         if node.op.how in LINEAR_DEVICE_REDUCERS:
             return _lower_reduce_sharded(node.op, node, state, ins, axis, n)
-        if tuple(node.inputs[0].spec.value_shape) == ():
-            return _lower_reduce_minmax_scalar_sharded(
-                node.op, node, state, ins, axis, n)
         return _lower_reduce_minmax_sharded(node.op, node, state, ins,
                                             axis, n)
     if kind == "join":

@@ -262,7 +262,7 @@ class DirtyScheduler:
                     deltas_in += len(batch)
 
         # fail loudly if any op state carries a sticky error flag (e.g. a
-        # retraction reached an insert-only device min/max) BEFORE corrupt
+        # retraction exhausted a min/max candidate buffer) BEFORE corrupt
         # deltas are folded into the materialized sink views. Streaming
         # ticks (sync=False) defer the check to the next sync point —
         # unless sink views are about to be materialized, which forces a
@@ -395,6 +395,22 @@ class DirtyScheduler:
         """Materialized multiset {(key, value): weight} at a sink."""
         name = sink if isinstance(sink, str) else sink.name
         return self.sink_views[name]
+
+    def refresh_minmax(self, node: Node, batch: DeltaBatch) -> None:
+        """Maintenance: rebuild a buffered min/max Reduce's candidate
+        buffers for every key in ``batch`` from a replay of its full live
+        multiset, resetting the monotone overflow latches (device
+        executors; the exact CPU oracle ignores it). Keeps long-running
+        heavy-churn keys exact instead of eventually tripping the loud
+        buffer-exhaustion error. Call between ticks."""
+        from reflow_tpu.executors.lowerings import LINEAR_DEVICE_REDUCERS
+        from reflow_tpu.graph import GraphError
+
+        if (node.kind != "op" or node.op.kind != "reduce"
+                or node.op.how in LINEAR_DEVICE_REDUCERS):
+            raise GraphError(f"{node}: refresh_minmax needs a min/max "
+                             f"Reduce node")
+        self.executor.refresh_minmax(node, batch)
 
     def view_dict(self, sink: str | Node) -> Dict:
         """Materialized {key: value} for unique-keyed sink collections."""

@@ -3,7 +3,7 @@
 A sixth example workload beyond the five BASELINE configs — the min-plus
 analog of PageRank's sum-loop, and the graph shape that exercises the
 retraction-capable device min/max (executors/lowerings.py
-``minmax_scalar_core``) inside the on-device fixpoint: every distance
+``minmax_core``) inside the on-device fixpoint: every distance
 improvement emits retract(old)/insert(new) through the min-Reduce, and
 edge churn retracts relaxation candidates outright.
 

@@ -428,3 +428,117 @@ def test_metrics_summary_over_streaming_history():
     s = summarize(sched.history)
     assert s.ticks == 3 and s.quiesced_all
     assert s.delta_ops > 0 and s.passes_mean >= 1.0
+
+
+def test_minmax_latch_refresh_soak():
+    """ROADMAP r3 #3 / VERDICT r3 #7: the over_lo/over_maybe_pos latches
+    are one-way, so a long-running high-churn key eventually trips the
+    loud error EVEN when the answer stays derivable from a replay.
+    refresh_minmax resets the latches from a full-multiset replay: the
+    same churn pattern that errors without refresh stays exact across a
+    10k-tick soak with it."""
+    import numpy as np
+
+    from reflow_tpu import DeltaBatch, DirtyScheduler, FlowGraph, Spec
+    from reflow_tpu.executors import get_executor
+
+    spec = Spec((), np.float32, key_space=8)
+
+    def build(candidates=2):
+        g = FlowGraph("soak")
+        src = g.source("s", spec)
+        red = g.reduce(src, "min", name="m", candidates=candidates)
+        return g, src, red
+
+    def hollow_cycle(sched, src, lo):
+        """insert {lo, lo+1, lo+2} (evicts lo+2 at candidates=2, latching
+        the watermark), then retract lo and lo+1: the buffer hollows past
+        the watermark -> unknowable from bounded state."""
+        vals = np.array([lo, lo + 1.0, lo + 2.0], np.float32)
+        sched.push(src, DeltaBatch(np.zeros(3, np.int64), vals,
+                                   np.ones(3, np.int64)))
+        sched.tick(sync=False)
+        sched.push(src, DeltaBatch(np.zeros(2, np.int64), vals[:2],
+                                   -np.ones(2, np.int64)))
+        sched.tick(sync=False)
+        return vals[2]   # the surviving row
+
+    # without refresh: the very first hollow cycle must raise loudly
+    g, src, red = build()
+    sched = DirtyScheduler(g, get_executor("tpu"))
+    hollow_cycle(sched, src, 100.0)
+    with pytest.raises(RuntimeError, match="min/max"):
+        sched.read_table(red)
+
+    # refresh's real use: latches POLLUTED BY HISTORY over a multiset
+    # that fits the buffer again. Epoch (4 ticks): insert {a,b,c} (c
+    # evicts -> watermark latches), retract c (the evicted value!),
+    # retract a, then refresh replays the true multiset {b} — resetting
+    # the stale latches — and retract b empties the key CLEANLY.
+    # Without the refresh the final retraction trips unknowable-state.
+    def epoch(sched, src, base_v, refresh_red=None):
+        vals = np.array([base_v, base_v + 1.0, base_v + 2.0], np.float32)
+        k3 = np.zeros(3, np.int64)
+        sched.push(src, DeltaBatch(k3, vals, np.ones(3, np.int64)))
+        sched.tick(sync=False)
+        for v in (vals[2], vals[0]):   # retract c (evicted), then a
+            sched.push(src, DeltaBatch(np.zeros(1, np.int64),
+                                       np.array([v], np.float32),
+                                       -np.ones(1, np.int64)))
+            sched.tick(sync=False)
+        if refresh_red is not None:    # replay the full live multiset {b}
+            sched.refresh_minmax(refresh_red, DeltaBatch(
+                np.zeros(1, np.int64), vals[1:2], np.ones(1, np.int64)))
+        sched.push(src, DeltaBatch(np.zeros(1, np.int64), vals[1:2],
+                                   -np.ones(1, np.int64)))
+        sched.tick(sync=False)
+
+    # without refresh: the epoch's last retraction trips the error
+    g, src, red = build()
+    sched = DirtyScheduler(g, get_executor("tpu"))
+    epoch(sched, src, 50.0)
+    with pytest.raises(RuntimeError, match="min/max"):
+        sched.read_table(red)
+
+    # with refresh: 2500 epochs x 4 ticks = 10k ticks, exact throughout
+    g, src, red = build()
+    sched = DirtyScheduler(g, get_executor("tpu"))
+    epochs = 2_500
+    for i in range(epochs):
+        epoch(sched, src, float(3 * i), refresh_red=red)
+        if i % 500 == 499:
+            assert sched.read_table(red) == {}   # sync point: no error
+    assert sched.read_table(red) == {}
+
+
+def test_minmax_latch_refresh_sharded():
+    """The routed refresh path: same polluted-latch epoch pattern on the
+    8-device mesh — replay rows reach their key's owner, latches reset
+    per shard, the final retraction stays clean."""
+    import numpy as np
+
+    from reflow_tpu import DeltaBatch, DirtyScheduler, FlowGraph, Spec
+    from reflow_tpu.parallel import make_mesh
+    from reflow_tpu.parallel.shard import ShardedTpuExecutor
+
+    spec = Spec((), np.float32, key_space=64)
+    g = FlowGraph("soak_sh")
+    src = g.source("s", spec)
+    red = g.reduce(src, "min", name="m", candidates=2)
+    sched = DirtyScheduler(g, ShardedTpuExecutor(make_mesh(8)))
+    # spread the pattern across keys owned by different shards
+    for i in range(6):
+        k = np.full(3, 9 * i % 64, np.int64)
+        vals = np.array([10.0 * i, 10.0 * i + 1, 10.0 * i + 2], np.float32)
+        sched.push(src, DeltaBatch(k, vals, np.ones(3, np.int64)))
+        sched.tick(sync=False)
+        for v in (vals[2], vals[0]):
+            sched.push(src, DeltaBatch(k[:1], np.array([v], np.float32),
+                                       -np.ones(1, np.int64)))
+            sched.tick(sync=False)
+        sched.refresh_minmax(red, DeltaBatch(
+            k[:1], vals[1:2], np.ones(1, np.int64)))
+        sched.push(src, DeltaBatch(k[:1], vals[1:2],
+                                   -np.ones(1, np.int64)))
+        sched.tick(sync=False)
+    assert sched.read_table(red) == {}
