@@ -67,6 +67,20 @@ class DeviceDelta(NamedTuple):
         )
 
 
+#: per-batch |w| mass bound of the device path's exact-f32 fold
+MAX_BATCH_WEIGHT_MASS = 1 << 24
+
+
+def check_weight_mass_value(total_mass) -> None:
+    """The ONE definition of the f32-exactness mass guard (threshold and
+    message), shared by every ingestion path — single-device, pre-sharded
+    chunks, and process-local multi-controller batches."""
+    if total_mass >= MAX_BATCH_WEIGHT_MASS:
+        raise ValueError(
+            "batch weight mass >= 2**24 exceeds the device path's exact "
+            "float32 range; split the batch across ticks")
+
+
 def check_weight_mass(batch: DeltaBatch) -> None:
     """Reject batches the device path cannot fold exactly.
 
@@ -75,10 +89,8 @@ def check_weight_mass(batch: DeltaBatch) -> None:
     would be silently inexact — fail loudly at the host boundary. Every
     host->device ingestion path (to_device, the macro-tick stacker) must
     call this."""
-    if len(batch) and int(np.abs(batch.weights).sum()) >= 1 << 24:
-        raise ValueError(
-            "batch weight mass >= 2**24 exceeds the device path's exact "
-            "float32 range; split the batch across ticks")
+    if len(batch):
+        check_weight_mass_value(int(np.abs(batch.weights).sum()))
 
 
 def to_device(batch: DeltaBatch, spec: Spec,

@@ -25,21 +25,14 @@ __all__ = ["topk", "chunked_corpus_topk", "NEG"]
 
 
 def _remote_tunnel_runtime() -> bool:
-    """True when the TPU sits behind the axon tunnel runtime (it
-    masquerades as platform "tpu"). Measured there: every execution of a
-    program containing a Pallas custom-call pays a multi-second fixed
-    penalty (~21s/exec at the k-NN bench shape vs ~0.05s device time),
-    so the XLA fallback wins by orders of magnitude despite the kernel
-    being faster on-chip. Override with REFLOW_TOPK_PALLAS=1/0.
-
-    Detection prefers axon's stable ``active_backend()`` accessor; the
-    env sentinel is the fallback (the plugin documents it as subject to
-    environ snapshot/restore)."""
-    try:
-        from axon.register import active_backend
-        return active_backend() is not None
-    except Exception:  # noqa: BLE001 - no axon installed / API drift
-        return os.environ.get("_AXON_REGISTERED") == "1"
+    """Measured on the tunnel runtime: every execution of a program
+    containing a Pallas custom-call pays a multi-second fixed penalty
+    (~21s/exec at the k-NN bench shape vs ~0.05s device time), so the
+    XLA fallback wins by orders of magnitude despite the kernel being
+    faster on-chip. Override with REFLOW_TOPK_PALLAS=1/0. (Detection
+    shared with the forced-sync advisory — utils/runtime.py.)"""
+    from reflow_tpu.utils.runtime import remote_tunnel_runtime
+    return remote_tunnel_runtime()
 
 
 def _pallas_default() -> Optional[bool]:

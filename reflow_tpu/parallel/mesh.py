@@ -19,19 +19,34 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["DELTA_AXIS", "make_mesh", "shard_batch", "shard_state_tree",
-           "replicate"]
+__all__ = ["DELTA_AXIS", "DCN_AXIS", "make_mesh", "shard_batch",
+           "shard_batch_process_local", "shard_state_tree", "replicate"]
 
 #: name of the mesh axis delta rows and key ranges are sharded over
 DELTA_AXIS = "delta"
+#: name of the slow (cross-host / data-center-network) mesh axis of a
+#: 2-axis mesh — the multi-slice dimension
+DCN_AXIS = "dcn"
 
 
 def make_mesh(n_devices: Optional[int] = None, *,
-              axis_name: str = DELTA_AXIS) -> Mesh:
-    """A 1-D device mesh over the first ``n_devices`` local devices.
+              axis_name: str = DELTA_AXIS,
+              dcn: Optional[int] = None) -> Mesh:
+    """A device mesh for the sharded executor.
 
-    On real hardware the device order jax reports follows the ICI torus, so
-    a 1-D mesh keeps neighbor collectives on ICI links.
+    1-D (default): the first ``n_devices`` local devices on one
+    ``axis_name`` axis. On real hardware the device order jax reports
+    follows the ICI torus, so a 1-D mesh keeps neighbor collectives on
+    ICI links.
+
+    2-D (``dcn=k``): a ``(DCN_AXIS, axis_name)`` mesh of shape
+    ``[k, n//k]`` over the GLOBAL device list, ordered so each dcn row
+    holds one process's (slice's) devices — under multi-controller JAX
+    set ``dcn = jax.process_count()`` and intra-row collectives ride
+    ICI while only the cross-row legs of the product-axis collectives
+    cross DCN. The sharded executor consumes either form: on a 2-axis
+    mesh it shards over the flattened ``(dcn, delta)`` product axis
+    (dcn-major, matching ``jax.lax.axis_index``'s flat order).
     """
     devs = jax.devices()
     n = len(devs) if n_devices is None else n_devices
@@ -40,7 +55,15 @@ def make_mesh(n_devices: Optional[int] = None, *,
             f"need {n} devices, have {len(devs)} "
             f"(set XLA_FLAGS=--xla_force_host_platform_device_count={n} "
             f"JAX_PLATFORMS=cpu for a virtual mesh)")
-    return Mesh(np.array(devs[:n]), (axis_name,))
+    if dcn is None:
+        return Mesh(np.array(devs[:n]), (axis_name,))
+    if n % dcn:
+        raise ValueError(f"n_devices {n} not divisible by dcn {dcn}")
+    # dcn rows group by process (slice) so the fast axis stays intra-host;
+    # within a process, jax's device order follows the ICI torus
+    ordered = sorted(devs[:n], key=lambda d: (d.process_index, d.id))
+    return Mesh(np.array(ordered).reshape(dcn, n // dcn),
+                (DCN_AXIS, axis_name))
 
 
 def _dim0_sharding(mesh: Mesh, axis_name: str, x) -> NamedSharding:
@@ -94,10 +117,9 @@ def shard_batch(chunks, spec, mesh: Mesh, *, capacity=None,
     from reflow_tpu.executors.device_delta import (DeviceDelta,
                                                    bucket_capacity, to_device)
 
-    if len(mesh.axis_names) != 1:
-        raise ValueError("shard_batch expects a 1-D mesh (one row axis); "
-                         f"got axes {mesh.axis_names}")
-    n = mesh.shape[axis_name]
+    axes = (tuple(mesh.axis_names) if len(mesh.axis_names) > 1
+            else axis_name)
+    n = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
     if len(chunks) != n:
         raise ValueError(f"need one chunk per mesh device ({n}), "
                          f"got {len(chunks)}")
@@ -110,12 +132,10 @@ def shard_batch(chunks, spec, mesh: Mesh, *, capacity=None,
     # the SAME exactness bound every host->device path enforces — checked
     # on the GLOBAL batch: after key routing all shards' contributions
     # fold into one f32 table, so per-chunk mass alone would under-guard
-    total_mass = sum(int(np.abs(np.asarray(c.weights)).sum())
-                     for c in chunks if len(c))
-    if total_mass >= 1 << 24:
-        raise ValueError(
-            "batch weight mass >= 2**24 exceeds the device path's exact "
-            "float32 range; split the batch across ticks")
+    from reflow_tpu.executors.device_delta import check_weight_mass_value
+
+    check_weight_mass_value(sum(int(np.abs(np.asarray(c.weights)).sum())
+                                for c in chunks if len(c)))
 
     devs = list(mesh.devices.ravel())
     # one host->owner transfer per chunk (to_device pads/casts exactly as
@@ -123,7 +143,7 @@ def shard_batch(chunks, spec, mesh: Mesh, *, capacity=None,
     # default device would double-hop n-1 chunks)
     locals_ = [to_device(c, spec, capacity=per, device=d)
                for c, d in zip(chunks, devs)]
-    sharding = NamedSharding(mesh, P(axis_name))
+    sharding = NamedSharding(mesh, P(axes))
 
     def stitch(col):
         shards = [getattr(l, col) for l in locals_]
@@ -132,3 +152,63 @@ def shard_batch(chunks, spec, mesh: Mesh, *, capacity=None,
             shape, sharding, shards)
 
     return DeviceDelta(stitch("keys"), stitch("values"), stitch("weights"))
+
+
+def shard_batch_process_local(chunk, spec, mesh: Mesh, *, capacity: int):
+    """Multi-controller ingestion: each PROCESS contributes its local
+    rows and the global row-sharded DeviceDelta assembles via
+    ``jax.make_array_from_process_local_data`` — the multi-host form of
+    :func:`shard_batch`, consumed identically by the SPMD tick.
+
+    ``chunk`` is this process's host :class:`DeltaBatch`;
+    ``capacity`` is the GLOBAL row capacity (a multiple of the mesh
+    size). Every process must call this (and the subsequent push/tick)
+    collectively with the same capacity. The f32-exactness mass guard
+    runs on the GLOBAL batch via one ``process_allgather`` of the local
+    masses — the same bound every host->device path enforces.
+    """
+    n = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    if capacity <= 0 or capacity % n:
+        raise ValueError(f"capacity {capacity} must be a positive "
+                         f"multiple of the mesh size {n}")
+    n_proc = jax.process_count()
+    n_local = capacity // n_proc
+    if len(chunk) > n_local:
+        raise ValueError(
+            f"local chunk ({len(chunk)} rows) exceeds this process's "
+            f"share {n_local} of capacity {capacity}")
+
+    local_mass = float(np.abs(np.asarray(chunk.weights)).sum()) \
+        if len(chunk) else 0.0
+    from reflow_tpu.executors.device_delta import check_weight_mass_value
+
+    if n_proc > 1:
+        from jax.experimental import multihost_utils
+        total_mass = float(np.sum(multihost_utils.process_allgather(
+            np.float64(local_mass))))
+    else:
+        total_mass = local_mass
+    check_weight_mass_value(total_mass)
+
+    m = len(chunk)
+    keys = np.zeros((n_local,), np.int32)
+    weights = np.zeros((n_local,), np.int32)
+    values = np.zeros((n_local,) + tuple(spec.value_shape),
+                      spec.value_dtype)
+    if m:
+        keys[:m] = np.asarray(chunk.keys, np.int64)
+        weights[:m] = np.asarray(chunk.weights)
+        values[:m] = np.asarray(chunk.values).reshape(
+            (m,) + tuple(spec.value_shape))
+
+    from reflow_tpu.executors.device_delta import DeviceDelta
+
+    axes = (tuple(mesh.axis_names) if len(mesh.axis_names) > 1
+            else mesh.axis_names[0])
+    sharding = NamedSharding(mesh, P(axes))
+
+    def assemble(local):
+        return jax.make_array_from_process_local_data(
+            sharding, local, (capacity,) + local.shape[1:])
+
+    return DeviceDelta(assemble(keys), assemble(values), assemble(weights))

@@ -40,8 +40,15 @@ class ShardedTpuExecutor(TpuExecutor):
     def __init__(self, mesh: Optional[Mesh] = None, *, fixpoint: bool = True):
         super().__init__(fixpoint=fixpoint)
         self.mesh = mesh if mesh is not None else make_mesh()
-        self.axis = self.mesh.axis_names[0]
-        self.n = self.mesh.shape[self.axis]
+        names = self.mesh.axis_names
+        #: a 2-axis (dcn, ici) mesh shards over the flattened PRODUCT
+        #: axis (dcn-major — jax.lax.axis_index's flat order): key ranges
+        #: span all chips, intra-slice legs of the collectives ride ICI,
+        #: only the cross-slice legs cross DCN. Every collective this
+        #: executor emits accepts the tuple form.
+        self.axis = names[0] if len(names) == 1 else tuple(names)
+        import numpy as _np
+        self.n = int(_np.prod([self.mesh.shape[a] for a in names]))
         if self.n & (self.n - 1) or self.n > MIN_CAPACITY:
             raise GraphError(
                 f"mesh size {self.n} must be a power of two <= "
@@ -63,6 +70,11 @@ class ShardedTpuExecutor(TpuExecutor):
         self._knn_ids = set()
         for node in graph.nodes:
             if node.kind == "op" and node.op.kind == "knn":
+                if isinstance(self.axis, tuple):
+                    raise GraphError(
+                        f"{node}: sharded k-NN's ring merge (ppermute) "
+                        f"needs a 1-axis mesh; run knn graphs on the ICI "
+                        f"mesh (make_mesh() without dcn=)")
                 Q = node.inputs[0].spec.key_space
                 D = node.inputs[1].spec.key_space
                 if Q % n or D % n:

@@ -71,6 +71,21 @@ def lazy_add(a, b):
     return LazyScalar(a, b)
 
 
+def _count_nonzero_global(w) -> int:
+    """Live-row count of a weights column that may be a MULTI-HOST global
+    array (process-local ingestion): np.asarray on a partially-
+    addressable array is illegal, so count the addressable shards. The
+    count is deliberately the PROCESS-LOCAL share on multi-controller
+    runs — never a hidden cross-process collective, which would deadlock
+    any non-SPMD metrics access (e.g. `if process_index() == 0:
+    summarize(...)`). Sum deltas_in across processes for global totals.
+    Single-controller arrays take the plain path."""
+    if getattr(w, "is_fully_addressable", True):
+        return int(np.count_nonzero(np.asarray(w)))
+    return sum(int(np.count_nonzero(np.asarray(s.data)))
+               for s in w.addressable_shards)
+
+
 @dataclasses.dataclass
 class TickResult:
     """Per-tick observability record (SURVEY.md §5 metrics).
@@ -94,6 +109,10 @@ class TickResult:
     #: (ADVICE r2: a pure-streaming run never otherwise checked)
     _check_errors: Optional[Callable[[], None]] = dataclasses.field(
         default=None, repr=False, compare=False)
+    #: this tick forced a mid-stream device readback (synchronous tick or
+    #: sink materialization on a device executor) — the tunnel-degrading
+    #: event counted by MetricsSummary.forced_syncs (VERDICT r3 weak #6)
+    forced_sync: bool = False
 
     @property
     def delta_ops(self) -> int:
@@ -139,6 +158,12 @@ class DirtyScheduler:
         self._tick = 0
         self.sink_views: Dict[str, Counter] = {s.name: Counter() for s in graph.sinks}
         self.history: List[TickResult] = []
+        #: mid-stream device readbacks this scheduler forced (sync ticks,
+        #: sink materialization, read_table on a device executor). On a
+        #: tunnel runtime the FIRST of these permanently degrades
+        #: dispatch, so the first increments also emits a one-time
+        #: warning (utils/runtime.note_forced_sync) — VERDICT r3 weak #6
+        self.forced_syncs = 0
 
     # -- host boundary in --------------------------------------------------
 
@@ -219,7 +244,7 @@ class DirtyScheduler:
         deltas_in = sum(len(b) for b in ingress.values()
                         if not hasattr(b, "nonzero"))
         dev_counts = [
-            (lambda w=b.weights: np.count_nonzero(np.asarray(w)))
+            (lambda w=b.weights: _count_nonzero_global(w))
             for b in ingress.values() if hasattr(b, "nonzero")]
         if dev_counts:
             deltas_in = LazyScalar(deltas_in, *dev_counts)
@@ -269,6 +294,9 @@ class DirtyScheduler:
         # sync anyway and must not fold corrupt deltas
         checked = sync or bool(sink_deltas)
         if checked:
+            if getattr(self.executor, "name", "") != "cpu":
+                self._note_forced_sync("synchronous tick / sink "
+                                       "materialization")
             self.executor.check_errors()
 
         out: Dict[str, DeltaBatch] = {}
@@ -296,6 +324,8 @@ class DirtyScheduler:
             wall_s=time.perf_counter() - t0,
             quiesced=quiesced,
             _check_errors=None if checked else self.executor.check_errors,
+            forced_sync=checked and getattr(self.executor, "name",
+                                            "") != "cpu",
         )
         self.history.append(result)
         return result
@@ -383,12 +413,20 @@ class DirtyScheduler:
 
     # -- host boundary out -------------------------------------------------
 
+    def _note_forced_sync(self, context: str) -> None:
+        from reflow_tpu.utils.runtime import note_forced_sync
+
+        self.forced_syncs += 1
+        note_forced_sync(context)
+
     def read_table(self, node: Node) -> Dict:
         """Materialized {key: value} of a stateful node's collection at the
         tick boundary (Reduce: last emitted aggregates; Join: the left
         table). This is the sink-style host crossing for collections that
         live inside loop regions, where a per-pass delta sink would force
         mid-tick readbacks."""
+        if getattr(self.executor, "name", "") != "cpu":
+            self._note_forced_sync("read_table")
         return self.executor.read_table(node)
 
     def view(self, sink: str | Node) -> Counter:

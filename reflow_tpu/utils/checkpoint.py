@@ -42,6 +42,13 @@ def _split_states(states: Dict[int, object]):
 
 
 def save_checkpoint(sched, path: str) -> None:
+    """Multi-controller: every process calls this collectively with the
+    same (shared-filesystem) path — orbax writes each process's
+    addressable shards of the global arrays; the host-side meta (tick
+    counter, sink views, dedup set — identical on every process by SPMD
+    construction) is written by process 0 alone."""
+    import jax
+
     os.makedirs(path, exist_ok=True)
     arr, host = _split_states(sched.executor.states)
     meta = {
@@ -56,8 +63,9 @@ def save_checkpoint(sched, path: str) -> None:
         "host_states": pickle.dumps(host),
         "has_array_states": bool(arr),
     }
-    with open(os.path.join(path, "meta.pkl"), "wb") as f:
-        pickle.dump(meta, f)
+    if jax.process_index() == 0:
+        with open(os.path.join(path, "meta.pkl"), "wb") as f:
+            pickle.dump(meta, f)
     if arr:
         import orbax.checkpoint as ocp
 

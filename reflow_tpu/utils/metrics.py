@@ -28,6 +28,10 @@ class MetricsSummary:
     tick_p95_s: float
     passes_mean: float
     quiesced_all: bool
+    #: ticks that forced a mid-stream device readback (the
+    #: tunnel-degrading event — see utils/runtime.note_forced_sync);
+    #: a streaming-shaped run should show 0 here until its sync point
+    forced_syncs: int
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -41,7 +45,7 @@ def summarize(history: Sequence) -> MetricsSummary:
     first — ``block()`` is idempotent and this is a sync point anyway.
     """
     if not history:
-        return MetricsSummary(0, 0, 0.0, 0.0, 0.0, 0.0, 0.0, True)
+        return MetricsSummary(0, 0, 0.0, 0.0, 0.0, 0.0, 0.0, True, 0)
     # ONE batched device_get of every device-resident scalar first: the
     # per-record block() then hits each jax.Array's cached host value
     # instead of issuing O(ticks x fields) sequential round trips (a
@@ -73,6 +77,8 @@ def summarize(history: Sequence) -> MetricsSummary:
         tick_p95_s=float(np.percentile(walls, 95)),
         passes_mean=float(np.mean([r.passes for r in history])),
         quiesced_all=all(r.quiesced for r in history),
+        forced_syncs=sum(bool(getattr(r, "forced_sync", False))
+                         for r in history),
     )
 
 

@@ -1,0 +1,70 @@
+"""Multi-host (DCN-axis) groundwork test (VERDICT r3 #6).
+
+Spawns TWO jax.distributed processes on the CPU platform (4 forced
+devices each -> 8 global), builds the 2-axis (dcn=2, ici=4) mesh, and
+runs the sharded PageRank build + churn tick with process-local
+ingestion, each process verifying its addressable rank shards against
+the dense reference (tests/multihost_worker.py).
+
+If jax.distributed cannot initialize in this harness (sandboxed
+networking), the test SKIPS with the manual recipe — the documented
+fallback VERDICT r3 #6 allows.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_dcn_mesh_tick():
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=4")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    worker = os.path.join(_REPO, "tests", "multihost_worker.py")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, coord, str(i), "2"],
+        env=env, cwd=_REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True) for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multihost workers timed out")
+
+    joined = "\n".join(outs)
+    if any(p.returncode for p in procs):
+        # distributed init unavailable in this sandbox -> documented skip
+        # with the manual recipe; any OTHER failure is a real bug
+        init_markers = ("DEADLINE_EXCEEDED", "UNAVAILABLE",
+                        "Failed to connect", "barrier timed out",
+                        "coordination service")
+        if any(m in joined for m in init_markers):
+            pytest.skip(
+                "jax.distributed could not initialize here; run manually:"
+                " for i in 0 1; do JAX_PLATFORMS=cpu XLA_FLAGS="
+                "--xla_force_host_platform_device_count=4 python "
+                "tests/multihost_worker.py 127.0.0.1:12345 $i 2 & done")
+        pytest.fail(f"multihost worker failed:\n{joined[-4000:]}")
+    assert "proc 0: verified" in joined and "proc 1: verified" in joined
