@@ -207,16 +207,15 @@ def _rowfn(fn: Callable, vectorized: bool) -> Callable:
 
 def _edge_budget_tiers(arena_capacity: int) -> List[int]:
     """Static gather budgets, large to small; the dense full-arena branch
-    sits above the largest. Measured regime (v5e, 1.31M-row arena): the
-    contribution scatter (~74M rows/s) dominates both branches and scales
-    with the branch's row count, and the budget pass's frontier-table
-    gather-expand costs ~22ns/row of HBM traffic — a budget pass runs at
-    ~40ns/row total vs the dense sweep's ~17.5ns/row over the FULL arena.
-    Crossover is therefore near arena/2, where a budget pass only ties
-    the dense sweep (measured: 25ms vs 23ms) — so the ladder starts at
-    arena/4 (clear win, ~11ms) and steps by ratio 2, bounding wasted
-    gather slots to 2x the live frontier. Six tiers keep the lax.switch
-    small; frontiers below the floor ride the smallest tier cheaply."""
+    sits above the largest. Measured regime (v5e, 1.31M-row arena,
+    round-4 microbench): a budget pass costs ~2ms of O(K) machinery +
+    ~55ns/slot of gathers+scatter (17.5ms at EB=262144, 3.6ms at 8192);
+    the dense branch costs ~23-25ms destination-sorted (segment_sum
+    16.2ms vs scatter-add 24.3ms for the fold alone) and ~34ms raw.
+    Crossover is therefore near arena/3; the ladder starts at arena/4
+    (clear budget win) and steps by ratio 2, bounding wasted gather
+    slots to 2x the live frontier. Six tiers keep the lax.switch small;
+    frontiers below the floor ride the smallest tier cheaply."""
     tiers = []
     c = 1 << (max(arena_capacity // 4, 1).bit_length() - 1)
     while c >= 2048 and len(tiers) < 6:
