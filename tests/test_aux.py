@@ -114,7 +114,7 @@ def test_metrics_summary():
     assert s.tick_p95_s >= s.tick_p50_s
 
 
-def test_device_minmax_insert_only_matches_cpu():
+def test_device_minmax_insert_matches_cpu():
     def build():
         g = FlowGraph("mm")
         spec = Spec((), np.float32, key_space=32)

@@ -1,9 +1,10 @@
 """Persistent-CSR cache of the fused linear fixpoint (VERDICT r3 #2).
 
-The sorted arena base persists across ticks on the program object and
-only the append tail is sorted per tick; a full rebuild happens in-program
-when the tail overflows its window or a compaction bumps the arena
-generation. These tests drive all three regimes against the CPU oracle.
+The sorted arena base persists across ticks on the EXECUTOR (one cache
+per join, shared by all program signatures) and only the append tail is
+sorted per tick; a full rebuild happens in-program when the tail
+overflows its window or a compaction bumps the arena generation. These
+tests drive all three regimes against the CPU oracle.
 """
 
 import numpy as np

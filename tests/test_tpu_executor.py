@@ -157,9 +157,9 @@ def test_tpu_rejects_unkeyed_spec():
         DirtyScheduler(g, get_executor("tpu"))
 
 
-def test_tpu_accepts_minmax_reducer_insert_only():
-    # min/max now lower to device scatter-extrema (insert-only; see
-    # tests/test_aux.py for the retraction error-flag behavior)
+def test_tpu_accepts_minmax_reducer():
+    # min/max lower to the buffered candidate kernel (see tests/test_aux.py
+    # for retraction exactness and the error-flag behavior)
     g = FlowGraph()
     src = g.source("in", Spec((), np.float32, key_space=8))
     g.sink(g.reduce(src, "min"), "out")
