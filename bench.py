@@ -66,7 +66,7 @@ def log(*a) -> None:
 
 
 def _build_pagerank(n_nodes: int, n_edges: int, churn: float,
-                    tol: float, seed: int = 7):
+                    tol: float, seed: int = 7, defer=None):
     from reflow_tpu.executors.device_delta import bucket_capacity
     from reflow_tpu.workloads import pagerank
 
@@ -75,7 +75,8 @@ def _build_pagerank(n_nodes: int, n_edges: int, churn: float,
     # cancelled pairs at high water, so capacity doesn't scale with ticks
     churn_cap = bucket_capacity(2 * int(churn * n_edges) + 2)
     arena = bucket_capacity(n_edges) + 8 * churn_cap
-    pr = pagerank.build_graph(n_nodes, tol=tol, arena_capacity=arena)
+    pr = pagerank.build_graph(n_nodes, tol=tol, arena_capacity=arena,
+                              defer_passes=defer)
     web = pagerank.WebGraph.random(n_nodes, n_edges, seed=seed)
     return pr, web
 
@@ -101,7 +102,21 @@ def _params():
             "REFLOW_BENCH_CPU_EDGES_CAP", 10_000 if smoke else 200_000)),
         "cpu_full": os.environ.get("REFLOW_BENCH_CPU_FULL") == "1",
         "tol": 1e-4,
+        # cross-tick residual deferral (close_loop defer_passes) for the
+        # pr_tpu_defer child — the incr_vs_full lever (VERDICT r4 #1);
+        # accuracy verified in-record against reference_ranks. Unset /
+        # empty / <= 0 all mean "no deferred child".
+        "defer": _defer_env(),
     }
+
+
+def _defer_env():
+    raw = os.environ.get("REFLOW_BENCH_DEFER", "2").strip()
+    try:
+        v = int(raw)
+    except ValueError:
+        return None
+    return v if v > 0 else None
 
 
 # -- config 3 measurements -------------------------------------------------
@@ -134,14 +149,20 @@ def run_pagerank_cpu(n_nodes: int, n_edges: int, churn: float, ticks: int,
     }
 
 
-def run_pagerank_tpu_child() -> dict:
+def run_pagerank_tpu_child(defer=None) -> dict:
     """Child process: the headline pipelined churn window on the device.
 
     Zero readbacks happen before the window (cold build, churn-shape
     compile absorption and all pushes are streaming); the window's
     closing readback is the process's FIRST, so the whole window runs
     with the tunnel in pipelined mode and the wall is a true
-    device-completion time for all N ticks."""
+    device-completion time for all N ticks.
+
+    ``defer`` (pr_tpu_defer child): the same window under cross-tick
+    residual deferral — quiescence is NOT asserted per tick; instead
+    the child drains after the windows and verifies the drained ranks
+    against the independent dense power-iteration oracle, recording the
+    mid-stream and drained error bounds alongside the throughput."""
     from bench_configs import _timed_tick
     from reflow_tpu.executors import get_executor
     from reflow_tpu.scheduler import DirtyScheduler
@@ -149,19 +170,29 @@ def run_pagerank_tpu_child() -> dict:
 
     p = _params()
     pr, web = _build_pagerank(p["n_nodes"], p["n_edges"], p["churn"],
-                              p["tol"])
+                              p["tol"], defer=defer)
     sched = DirtyScheduler(pr.graph, get_executor("tpu"))
     sched.push(pr.teleport, pagerank.teleport_batch(p["n_nodes"]))
     sched.push(pr.edges, web.initial_batch())
     t0 = time.perf_counter()
     sched.tick(sync=False)
     build_dispatch_s = time.perf_counter() - t0   # includes the compile
-    for _ in range(2):   # absorb the churn-shape compile, reach steady state
-        sched.push(pr.edges, web.churn(p["churn"]))
-        sched.tick(sync=False)
+    warm = 2 if defer is None else max(2, 24 // defer)
+    for _ in range(warm):  # absorb the churn-shape compile + (deferred:
+        sched.push(pr.edges, web.churn(p["churn"]))   # converge the cold
+        sched.tick(sync=False)                        # build's residue)
     from bench_configs import _settle
     _settle(0 if p["smoke"] else 15, log,
             "drain cold build + warmup ticks before the window")
+    if defer is not None:
+        # converge the cold build's residue before measuring: the window
+        # then measures steady-state churn tracking, not amortized
+        # initial convergence. drain() is synchronous, which flips the
+        # tunnel into degraded dispatch — that's the regime the median
+        # window lands in anyway (window 1's pipelined mode is the
+        # documented outlier), so the windows stay comparable.
+        cold_drain_ticks = sched.drain(pr.edges)
+        log(f"cold-build residue drained in {cold_drain_ticks} ticks")
 
     # NOTE on tick_many (the lax.scan macro-tick): it amortizes the
     # tunnel's fixed per-execution overhead K-fold and is the right shape
@@ -186,13 +217,66 @@ def run_pagerank_tpu_child() -> dict:
     def run_churn_window():
         wall, dwall, results = _stream_window(
             sched, lambda i: sched.push(pr.edges, web.churn(p["churn"])), n)
-        assert all(r.quiesced for r in results)
+        if defer is None:
+            assert all(r.quiesced for r in results)
         return wall, dwall, sum(r.delta_ops for r in results)
 
     wall, dwall, dops, windows = _median_window(
-        run_churn_window, log, f"pagerank churn x{n}")
+        run_churn_window, log, f"pagerank churn x{n}"
+        + (f" defer={defer}" if defer else ""))
     windows = [{"wall_s": round(w, 3), "dispatch_s": round(d, 3),
                 "delta_ops": o} for w, d, o in windows]
+
+    extra = {}
+    if defer is None and not p["smoke"]:
+        # the quiescent mode's own accuracy vs the independent oracle:
+        # the fair baseline band for the deferred child's error fields
+        # (both modes carry tol-lag; deferral must not add beyond it)
+        import numpy as _np
+        from reflow_tpu.workloads import pagerank as _pg
+        ranks_q = _pg.ranks_to_array(sched.read_table(pr.new_rank),
+                                     p["n_nodes"])
+        ref_q = _pg.reference_ranks(web)
+        extra["max_abs_err_vs_reference"] = round(
+            float(_np.abs(ranks_q - ref_q).max()), 6)
+        extra["max_rel_err_vs_reference"] = round(float(
+            (_np.abs(ranks_q - ref_q) / _np.maximum(ref_q, 1.0)).max()), 6)
+        log(f"quiescent accuracy vs reference: "
+            f"abs={extra['max_abs_err_vs_reference']} "
+            f"rel={extra['max_rel_err_vs_reference']}")
+    if defer is not None:
+        # the deferred mode's accuracy contract, measured in-record:
+        # mid-stream lag right after the last window, then drained ranks
+        # vs the INDEPENDENT dense power-iteration oracle (5e-4 is the
+        # VERDICT-prescribed bound on the drained side)
+        import numpy as _np
+        from reflow_tpu.workloads import pagerank as _pg
+        ref = _pg.reference_ranks(web)
+        mid = _pg.ranks_to_array(sched.read_table(pr.new_rank),
+                                 p["n_nodes"])
+        t_dr = time.perf_counter()
+        drain_ticks = sched.drain(pr.edges)
+        drain_s = time.perf_counter() - t_dr
+        drained = _pg.ranks_to_array(sched.read_table(pr.new_rank),
+                                     p["n_nodes"])
+        rel = lambda a: float((_np.abs(a - ref)
+                               / _np.maximum(ref, 1.0)).max())
+        extra = {
+            "defer_passes": defer,
+            "mid_stream_max_abs_err": round(
+                float(_np.abs(mid - ref).max()), 6),
+            "mid_stream_max_rel_err": round(rel(mid), 6),
+            "drain_ticks": drain_ticks,
+            "drain_s": round(drain_s, 2),
+            "drained_max_abs_err": round(
+                float(_np.abs(drained - ref).max()), 6),
+            "drained_max_rel_err": round(rel(drained), 6),
+        }
+        log(f"deferred accuracy: mid={extra['mid_stream_max_abs_err']} "
+            f"(rel {extra['mid_stream_max_rel_err']}) "
+            f"drained={extra['drained_max_abs_err']} "
+            f"(rel {extra['drained_max_rel_err']}) "
+            f"(drain {drain_ticks} ticks / {drain_s:.1f}s)")
 
     # post-window extras (tunnel now degraded — every sync pays ~0.1s, so
     # these are conservative upper bounds, never enqueue times)
@@ -217,6 +301,7 @@ def run_pagerank_tpu_child() -> dict:
         "delta_ops_per_s": round(dops / wall),
         "delta_ops_per_tick": round(dops / n),
         "tick_s_synced_degraded": round(synced_s, 3),
+        **extra,
     }
 
 
@@ -280,6 +365,11 @@ def _child(name):
 @_child("pr_tpu")
 def _c_pr_tpu():
     return run_pagerank_tpu_child()
+
+
+@_child("pr_tpu_defer")
+def _c_pr_tpu_defer():
+    return run_pagerank_tpu_child(defer=_params()["defer"])
 
 
 @_child("pr_full")
@@ -357,13 +447,45 @@ def main() -> None:
             "error": tpu["error"],
         }))
         return
-    full = _spawn("pr_full")
-    log("full:", json.dumps(full))
-    incr_vs_full = None
-    if "full_recompute_s" in full:
-        incr_vs_full = full["full_recompute_s"] / tpu["tick_s_amortized"]
-        log(f"incremental-vs-full (tpu executor, warm, pipelined window): "
-            f"{incr_vs_full:.1f}x")
+    # the deferred window (cross-tick residual deferral, defer_passes):
+    # the incr_vs_full lever, with its accuracy contract measured in the
+    # child (mid-stream + drained error vs the independent oracle)
+    tpud = None
+    if p["defer"]:
+        tpud = _spawn("pr_tpu_defer")
+        log("tpu_defer:", json.dumps(tpud))
+        if "error" in tpud:
+            tpud = None
+
+    # full-recompute baseline: MEDIAN OF 3 SUBPROCESSES (VERDICT r4 #2 —
+    # one subprocess snapshot was the bottom of the variance band). Each
+    # child still takes min-of-3 in-process rounds (the outlier guard on
+    # the numerator's pipelined-vs-degraded regimes); the cross-process
+    # median guards the day-dependent tunnel.
+    full_runs = []
+    for i in range(1 if p["smoke"] else 3):
+        r = _spawn("pr_full")
+        log(f"full[{i}]:", json.dumps(r))
+        if "full_recompute_s" in r:
+            full_runs.append(r["full_recompute_s"])
+    incr_vs_full = incr_vs_full_q = None
+    incr_vs_full_runs = []
+    full_med = float(np.median(full_runs)) if full_runs else None
+    if full_med is not None:
+        incr_vs_full_q = full_med / tpu["tick_s_amortized"]
+        log(f"incremental-vs-full (quiescent window): "
+            f"{incr_vs_full_q:.1f}x")
+        if tpud is not None:
+            incr_vs_full = full_med / tpud["tick_s_amortized"]
+            incr_vs_full_runs = [
+                round(f / tpud["tick_s_amortized"], 2) for f in full_runs]
+            log(f"incremental-vs-full (deferred window, "
+                f"defer={tpud.get('defer_passes')}): {incr_vs_full:.1f}x "
+                f"runs={incr_vs_full_runs}")
+        else:
+            incr_vs_full = incr_vs_full_q
+            incr_vs_full_runs = [
+                round(f / tpu["tick_s_amortized"], 2) for f in full_runs]
 
     # CPU baseline: measured at the cap, with a scaling sweep making the
     # per-row-rate extrapolation explicit (the rate is flat-to-declining
@@ -399,6 +521,16 @@ def main() -> None:
         "cpu_edges": cpu["edges"],
         "incr_vs_full": (round(incr_vs_full, 2)
                          if incr_vs_full is not None else None),
+        "incr_vs_full_runs": incr_vs_full_runs,
+        "incr_vs_full_quiescent": (round(incr_vs_full_q, 2)
+                                   if incr_vs_full_q is not None else None),
+        "full_recompute_runs_s": full_runs,
+        **({"defer_passes": tpud.get("defer_passes"),
+            "deferred_tick_s_amortized": tpud.get("tick_s_amortized"),
+            "deferred_mid_stream_max_abs_err":
+                tpud.get("mid_stream_max_abs_err"),
+            "deferred_drained_max_abs_err":
+                tpud.get("drained_max_abs_err")} if tpud else {}),
     }))
 
 

@@ -598,8 +598,12 @@ def cfg5_image_embed(smoke: bool, log) -> None:
             "images_per_s": round(img_per_s, 2),
             "model_gflop_per_image": round(flops / 1e9, 1),
             "achieved_tflops": round(img_per_s * flops / 1e12, 2),
+            # aggregate mesh throughput against the AGGREGATE mesh peak
+            # (ADVICE r4: dividing by one chip's peak inflated MFU by the
+            # mesh size on multi-device meshes)
             "mfu_pct_vs_v5e_bf16_peak": round(
-                100 * img_per_s * flops / peak, 2),
+                100 * img_per_s * flops
+                / (peak * len(mesh.devices.ravel())), 2),
             "upload_mb_per_tick": round(upload_mb, 1),
             "dispatch_ms_total": round(1e3 * dwall, 1),
             "move_tick_ms": round(1e3 * move_wall, 1),

@@ -292,7 +292,16 @@ class LinearFixpointProgram(_MacroTickMixin):
         Q = 1
         for s in arena_vshape:
             Q *= s
-        mi = max_iters
+        #: cross-tick residual deferral (close_loop defer_passes): cap the
+        #: while_loop at ``defer`` passes per tick and carry the live
+        #: observables ``xw`` across ticks in the loop node's ``resid``
+        #: state leaf instead of iterating to quiescence. The left-table
+        #: patch then tracks the FOLDED collection A = emitted - resid
+        #: (in-flight emission rows have not passed through the Join yet),
+        #: which keeps the schedule exactly equal to a host loop that
+        #: stops after the same passes. Accuracy contract: docs/guide.md.
+        defer = L.defer_passes
+        mi = min(max_iters, defer) if defer else max_iters
         # shard context: under a ShardedTpuExecutor the whole loop runs
         # inside ONE shard_map region — per-shard CSR over the local arena
         # slice (arena keys are shard-local by construction of the routed
@@ -453,7 +462,7 @@ class LinearFixpointProgram(_MacroTickMixin):
             ew = jnp.where(valid, sv[:, Q].astype(jnp.int32), 0)
             okey, wv, wc = push(src + base, jnp.asarray(x, jnp.float32),
                                 dwx, vb, ew)
-            return scatter_tab(okey, wv, wc)
+            return scatter_tab(okey, wv, wc), jnp.zeros((), jnp.bool_)
 
         def dense_tab(arena, xw, base):
             """Full-arena push — the always-correct top tier. Sweeps the
@@ -463,7 +472,7 @@ class LinearFixpointProgram(_MacroTickMixin):
             g = xw[rk]                          # [Rl, P+1] one gather
             x = g[:, :P].reshape((rk.shape[0],) + loop_vshape)
             okey, wv, wc = push(rk + base, x, g[:, P], rv, rw)
-            return scatter_tab(okey, wv, wc)
+            return scatter_tab(okey, wv, wc), jnp.zeros((), jnp.bool_)
 
         def dense_sorted_tab(dokey, dsrc, dvalw, xw, base):
             """Base-rows dense push over the destination-SORTED copy: the
@@ -477,21 +486,29 @@ class LinearFixpointProgram(_MacroTickMixin):
             vb = jnp.asarray(dvalw[:, :Q], vdtype).reshape(
                 (Rl_,) + arena_vshape)
             ew = dvalw[:, Q].astype(jnp.int32)
-            # the runtime okey from push is IGNORED: stable_key declares
-            # it equals the precomputed (sorted) destination
-            _, wv, wc = push(src_c + base, x, g[:, P], vb, ew)
+            # stable_key declares the runtime okey equals the precomputed
+            # (sorted) destination. The declaration is near-free to CHECK
+            # here (okey is already computed): a key_fn that actually
+            # reads the loop value would otherwise corrupt ranks
+            # tier-selection-dependently (ADVICE r4) — route the mismatch
+            # into the join's sticky error instead.
+            okey, wv, wc = push(src_c + base, x, g[:, P], vb, ew)
+            bad = jnp.any((okey != dokey) & (ew != 0))
             upd = jnp.concatenate([wv.reshape(Rl_, -1), wc[:, None]],
                                   axis=-1)
             return jax.ops.segment_sum(upd, dokey, num_segments=KR,
-                                       indices_are_sorted=True)
+                                       indices_are_sorted=True), bad
 
-        def loop_region(jstate, rstate, csr, ld, has_entry):
+        def loop_region(jstate, rstate, csr, ld, has_entry, resid):
             """Phase B on one shard's slices (the whole mesh's arrays when
             single-device): observables from the loop delta, CSR cache
             validation + tail build, the while_loop, and the Join
             left-table patch. ``ld`` rows are owner-aligned by
             construction (loop deltas are always Reduce emissions, which
-            each shard emits over its owned key range)."""
+            each shard emits over its owned key range). ``resid`` (defer
+            mode only, else None) is the carried [Klc, P+1] observable
+            block from the previous tick; the final ``xw`` is returned as
+            the next tick's carry."""
             Klc = rstate["emitted_has"].shape[0]   # local loop/key rows
             if axis is not None:
                 base = (jax.lax.axis_index(axis) * Klc).astype(jnp.int32)
@@ -508,6 +525,14 @@ class LinearFixpointProgram(_MacroTickMixin):
             xw = jnp.concatenate(
                 [dval.reshape(Klc, P), dw.astype(jnp.float32)[:, None]],
                 axis=1)
+            if resid is not None:
+                # carried residue joins the loop-delta stream at the FIRST
+                # loop pass (pushed against the post-churn arena) — the
+                # exact schedule a host loop resuming its stashed back-edge
+                # rows would run, since the region is linear and the Join
+                # bilinear (phase A already joined deltas against the
+                # folded A, which excludes the in-flight rows)
+                xw = xw + resid
 
             rk, rv, rw = jstate["rkeys"], jstate["rvals"], jstate["rw"]
             Rcap = rk.shape[0]
@@ -622,7 +647,8 @@ class LinearFixpointProgram(_MacroTickMixin):
                 for EB in tail_tiers
             ]
             branches_t.append(
-                lambda xw: jnp.zeros((KR, P + 1), jnp.float32))
+                lambda xw: (jnp.zeros((KR, P + 1), jnp.float32),
+                            jnp.zeros((), jnp.bool_)))
             zero_ix = len(tail_tiers)
 
             def live(xw):
@@ -634,11 +660,11 @@ class LinearFixpointProgram(_MacroTickMixin):
                 return l
 
             def cond(c):
-                rst, xw, it, rows = c
+                rst, xw, it, rows, err = c
                 return jnp.logical_and(it < mi, live(xw))
 
             def body(c):
-                rst, xw, it, rows = c
+                rst, xw, it, rows, err = c
                 fmask = jnp.any(xw != 0, axis=1)
                 if tiers:
                     nedges = jnp.sum(jnp.where(fmask, deg_b_i, 0))
@@ -658,7 +684,7 @@ class LinearFixpointProgram(_MacroTickMixin):
                     ix_b = jnp.where(n_fits > 0, n_fits - 1, dense_ix)
                 else:
                     ix_b = jnp.full((), dense_ix, jnp.int32)
-                tab = jax.lax.switch(ix_b, branches_b, xw)
+                tab, bad_b = jax.lax.switch(ix_b, branches_b, xw)
                 # tail segment: skipped when the frontier doesn't touch
                 # any tail source (nt == 0 — the common late-pass case
                 # once the wave moves past the churned keys). The RAW
@@ -676,46 +702,80 @@ class LinearFixpointProgram(_MacroTickMixin):
                     (ix_b == dense_ix) | (nt == 0))
                 ix_t = jnp.where(skip_t, zero_ix,
                                  jnp.maximum(nt_fits - 1, 0))
-                tab = tab + jax.lax.switch(ix_t, branches_t, xw)
+                tab_t, bad_t = jax.lax.switch(ix_t, branches_t, xw)
+                tab = tab + tab_t
                 rst2, xw2, prows = fold(rst, tab)
-                return rst2, xw2, it + 1, rows + prows
+                return (rst2, xw2, it + 1, rows + prows,
+                        err | bad_b | bad_t)
 
-            rstate, xw, iters, rows = jax.lax.while_loop(
+            rstate, xw, iters, rows, skerr = jax.lax.while_loop(
                 cond, body, (rstate, xw, jnp.zeros((), jnp.int32),
-                             jnp.zeros((), jnp.int32)))
+                             jnp.zeros((), jnp.int32),
+                             jnp.zeros((), jnp.bool_)))
             converged = ~live(xw)
+            if axis is not None:
+                skerr = jax.lax.pmax(skerr.astype(jnp.int32), axis) > 0
 
             # patch the Join's left table densely (per-pass retract/insert
             # pairs cancel; only entry-vs-exit existence and value matter)
             has_f = rstate["emitted_has"]
             em_f = rstate["emitted"]
             new_jstate = dict(jstate)
-            new_jstate["lval"] = jnp.where(
-                _bcast_w(has_f, em_f),
-                jnp.asarray(em_f, jstate["lval"].dtype), jstate["lval"])
-            new_jstate["lw"] = (jstate["lw"] + has_f.astype(jnp.int32)
-                                - has_entry.astype(jnp.int32))
+            # a violated stable_key declaration surfaces as the join's
+            # sticky error at the next sync — loudly, before corrupt
+            # ranks reach any view (ADVICE r4)
+            new_jstate["error"] = jstate["error"] | skerr
+            if resid is None:
+                new_jstate["lval"] = jnp.where(
+                    _bcast_w(has_f, em_f),
+                    jnp.asarray(em_f, jstate["lval"].dtype), jstate["lval"])
+                new_jstate["lw"] = (jstate["lw"] + has_f.astype(jnp.int32)
+                                    - has_entry.astype(jnp.int32))
+            else:
+                # defer mode: the final xw is still in flight, so the
+                # FOLDED collection lags the emitted table by exactly its
+                # observables: A = emitted - xw. Invariant at entry was
+                # lw = has_entry - resid_dw (same formula, last tick), so
+                # the weight delta nets the two residues. lval for keys
+                # without an emission (pure retraction in flight) keeps
+                # its old folded value — the where() leaves it alone.
+                rout_dval = xw[:, :P].reshape((Klc,) + loop_vshape)
+                lval_t = em_f.astype(jnp.float32) - rout_dval
+                new_jstate["lval"] = jnp.where(
+                    _bcast_w(has_f, em_f),
+                    jnp.asarray(lval_t, jstate["lval"].dtype),
+                    jstate["lval"])
+                ddw = jnp.round(xw[:, P] - resid[:, P]).astype(jnp.int32)
+                new_jstate["lw"] = (jstate["lw"] + has_f.astype(jnp.int32)
+                                    - has_entry.astype(jnp.int32) - ddw)
             new_csr = {"geo": geo_b, "svalw": svalw_b,
                        "count": bcount[None], "gen": gen[None]}
             if stable_dst:
                 new_csr.update(dokey=dokey_b, dsrc=dsrc_b, dvalw=dvalw_b)
-            return new_jstate, rstate, new_csr, iters, rows, converged
+            if resid is None:
+                return new_jstate, rstate, new_csr, iters, rows, converged
+            return new_jstate, rstate, new_csr, iters, rows, converged, xw
 
-        def run_loop(jstate, rstate, csr, ld, has_entry):
+        def run_loop(jstate, rstate, csr, ld, has_entry, resid):
             if axis is None:
-                return loop_region(jstate, rstate, csr, ld, has_entry)
+                return loop_region(jstate, rstate, csr, ld, has_entry, resid)
             from jax.sharding import PartitionSpec as PS
 
             jspec = executor._state_tree_specs({join_id: jstate})[join_id]
             rspec = executor._state_tree_specs({red_id: rstate})[red_id]
             cspec = {k: PS(axis) for k in csr}
             dspec = DeviceDelta(PS(axis), PS(axis), PS(axis))
+            # resid (defer mode) adds one key-sharded operand and the
+            # carried-out observables; None is spec'd as a leafless pytree
+            rs_in = PS(axis) if resid is not None else None
+            out_specs = (jspec, rspec, cspec, PS(), PS(), PS())
+            if resid is not None:
+                out_specs = out_specs + (PS(axis),)
             fn = jax.shard_map(
                 loop_region, mesh=mesh,
-                in_specs=(jspec, rspec, cspec, dspec, PS(axis)),
-                out_specs=(jspec, rspec, cspec, PS(), PS(), PS()),
-                check_vma=False)
-            return fn(jstate, rstate, csr, ld, has_entry)
+                in_specs=(jspec, rspec, cspec, dspec, PS(axis), rs_in),
+                out_specs=out_specs, check_vma=False)
+            return fn(jstate, rstate, csr, ld, has_entry, resid)
 
         def tick_fn(op_states, csr, ingress):
             # the loop folds every emission from phase A's onward into the
@@ -726,11 +786,24 @@ class LinearFixpointProgram(_MacroTickMixin):
             snaps = {n.id: (states[n.id]["emitted"],
                             states[n.id]["emitted_has"]) for n in boundary}
 
-            if loop_id in eg_a:
-                new_jstate, rstate, csr, iters, rows, converged = run_loop(
-                    states[join_id], states[red_id], csr, eg_a[loop_id],
-                    has_entry)
+            ld = eg_a.get(loop_id)
+            if defer and ld is None:
+                # carried residue may still be live even when phase A
+                # emitted no loop delta: run the loop with an empty delta
+                # (trace-static shape; weight-0 rows are no-ops)
+                from reflow_tpu.executors.device_delta import MIN_CAPACITY
+                ld = DeviceDelta.empty(L.spec, MIN_CAPACITY)
+            if ld is not None:
+                resid = states[loop_id]["resid"] if defer else None
+                out = run_loop(states[join_id], states[red_id], csr, ld,
+                               has_entry, resid)
                 states = dict(states)
+                if defer:
+                    (new_jstate, rstate, csr, iters, rows, converged,
+                     resid_out) = out
+                    states[loop_id] = {"resid": resid_out}
+                else:
+                    new_jstate, rstate, csr, iters, rows, converged = out
                 states[join_id] = new_jstate
                 states[red_id] = rstate
             else:

@@ -78,6 +78,23 @@ class TpuExecutor(Executor):
         self._csr_cache.clear()
         self.graph = graph
         self.states = {}
+        for loop in graph.loops:
+            if loop.defer_passes:
+                # cross-tick residual deferral: the loop carries its
+                # un-propagated emission deltas as dense linear
+                # observables [K, P+1] (flattened dval columns + dw).
+                # SEMANTIC state — checkpointed with the state tree,
+                # unlike the derived CSR cache (docs/guide.md).
+                import jax.numpy as jnp
+                import numpy as np
+                K = loop.spec.key_space
+                if K <= 0:
+                    raise GraphError(
+                        f"{loop}: defer_passes needs key_space > 0")
+                P = int(np.prod(loop.spec.value_shape)) if \
+                    loop.spec.value_shape else 1
+                self.states[loop.id] = {
+                    "resid": jnp.zeros((K, P + 1), jnp.float32)}
         for node in graph.nodes:
             if node.kind != "op":
                 continue
@@ -473,12 +490,16 @@ class TpuExecutor(Executor):
                     "is invalid — re-run on the CPU executor or widen "
                     "the buffer")
         if node.kind == "op" and node.op.kind == "join":
-            return ("join sticky error: either the arena overflowed (live "
-                    "rows + appends exceeded capacity even after in-program "
-                    "compaction — raise arena_capacity) or, under a sharded "
+            return ("join sticky error: the arena overflowed (live rows + "
+                    "appends exceeded capacity even after in-program "
+                    "compaction — raise arena_capacity); or, under a sharded "
                     "executor, sparse routing overflowed its per-destination "
                     "budget (key skew — raise delta capacity or rebalance "
-                    "the key space); this tick's state is invalid")
+                    "the key space); or a downstream GroupBy's "
+                    "stable_key=True declaration was violated (its key_fn "
+                    "read the loop value — the fused fixpoint's dense tier "
+                    "caught a precomputed/runtime destination mismatch); "
+                    "this tick's state is invalid")
         return ("sticky device error flag set (sparse-route overflow: key "
                 "skew exceeded the ROUTE_SLACK per-destination budget); "
                 "this tick's state is invalid — raise the delta capacity "

@@ -44,6 +44,14 @@ class Node:
     sharding: Optional[str] = None
     # optional partition/stage assignment for topo-partitioned execution
     stage: Optional[int] = None
+    # loop nodes: cap on fixpoint passes per tick (close_loop defer_passes).
+    # None = run to quiescence every tick. When set, device fixpoint
+    # programs may stop after this many passes and carry the residual
+    # loop deltas into the next tick (cross-tick residual deferral — see
+    # docs/guide.md "Deferred fixpoint"); the CPU oracle and the
+    # row-based device program always run to quiescence (strictly more
+    # converged, same fixpoint).
+    defer_passes: Optional[int] = None
 
     def __hash__(self):
         return self.id
@@ -118,14 +126,25 @@ class FlowGraph:
         self.loops.append(node)
         return node
 
-    def close_loop(self, loop: Node, result: Node) -> None:
+    def close_loop(self, loop: Node, result: Node, *,
+                   defer_passes: Optional[int] = None) -> None:
+        """Close a loop's back-edge. ``defer_passes`` opts the region into
+        cross-tick residual deferral: a device fixpoint program may stop
+        after that many passes per tick, carrying the un-propagated loop
+        deltas (as dense linear observables) into the next tick instead
+        of iterating to quiescence. Amortizes convergence across a churn
+        stream at a documented accuracy trade (docs/guide.md "Deferred
+        fixpoint"); ``DirtyScheduler.drain`` flushes the residue."""
         if loop.kind != "loop":
             raise GraphError(f"{loop} is not a loop node")
         if loop.back_input is not None:
             raise GraphError(f"{loop} already closed")
         if result not in self.nodes:
             raise GraphError(f"{result} is not a node of this graph")
+        if defer_passes is not None and defer_passes < 1:
+            raise GraphError(f"defer_passes must be >= 1, got {defer_passes}")
         loop.back_input = result
+        loop.defer_passes = defer_passes
 
     # op sugar -------------------------------------------------------------
 
@@ -165,9 +184,13 @@ class FlowGraph:
     def join(self, left: Node, right: Node, merge: Optional[Callable] = None,
              *, name: Optional[str] = None, spec: Optional[Spec] = None,
              arena_capacity: int = 1 << 16,
-             linear_left: bool = False) -> Node:
+             linear_left: bool = False,
+             left_arena_capacity: Optional[int] = None,
+             product_slack: int = 4) -> Node:
         op = Join(merge, out_spec=spec, arena_capacity=arena_capacity,
-                  linear_left=linear_left)
+                  linear_left=linear_left,
+                  left_arena_capacity=left_arena_capacity,
+                  product_slack=product_slack)
         return self.add_op(op, [left, right], name=name)
 
     def union(self, *inputs: Node, name: Optional[str] = None) -> Node:

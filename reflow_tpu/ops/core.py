@@ -384,12 +384,22 @@ class Join(Op):
 
     def __init__(self, merge: Optional[Callable] = None, *,
                  out_spec: Optional[Spec] = None, arena_capacity: int = 1 << 16,
-                 linear_left: bool = False):
+                 linear_left: bool = False,
+                 left_arena_capacity: Optional[int] = None,
+                 product_slack: int = 4):
         self.merge = merge
         self._out_spec = out_spec
         #: device-path right-side arena capacity (rows); the TPU executor
         #: stores the right collection as a fixed-size append log.
         self.arena_capacity = arena_capacity
+        #: MULTISET-left device path only (left Spec not unique): the left
+        #: side is a second append arena of this capacity (defaults to
+        #: arena_capacity), and each tick's delta×arena products run at a
+        #: static budget of ``product_slack x delta_capacity`` pair slots
+        #: per side — a true pair count beyond the budget sets the sticky
+        #: error (loud, never truncation). Unique-left joins ignore both.
+        self.left_arena_capacity = left_arena_capacity
+        self.product_slack = product_slack
         #: declares ``merge(k, va, vb)`` linear in ``va`` (so
         #: ``merge(k, 0, vb)`` zeroes every va-dependent component), and —
         #: if a GroupBy consumes this join — that its ``key_fn``/any

@@ -411,6 +411,47 @@ class DirtyScheduler:
         self.history.append(result)
         return result
 
+    def drain(self, source: Node, *, max_ticks: int = 256) -> int:
+        """Tick with empty (zero-weight probe) input at ``source`` until
+        the graph quiesces. Flushes the residue a deferred fixpoint
+        (``close_loop(defer_passes=...)``) carries across ticks: each
+        drain tick runs up to ``defer_passes`` more loop passes over the
+        in-flight observables, so the state converges to the same
+        fixpoint a quiescent tick would have reached (docs/guide.md
+        "Deferred fixpoint"). Synchronous by necessity (each round reads
+        the quiescence flag back); call at stream boundaries, not inside
+        a pipelined window. Returns the number of ticks used; raises if
+        quiescence is not reached within ``max_ticks``."""
+        if source.kind not in ("source", "loop"):
+            raise GraphError(f"drain probes a source/loop, not {source}")
+        # the probe must structurally reach every deferred loop's region,
+        # or its ticks would report quiescence without ever running the
+        # region's program (belt-and-braces: the fused program runs the
+        # loop on ANY tick, but a fallback executor honors only the plan)
+        deferred = [l for l in self.graph.loops if l.defer_passes]
+        if deferred:
+            plan_ids = {n.id for n in self._dirty_plan([source.id])}
+            for l in deferred:
+                if l.back_input.id not in plan_ids:
+                    raise GraphError(
+                        f"drain({source.name}) does not reach deferred "
+                        f"loop {l.name}'s region; probe a source feeding "
+                        f"that region instead")
+        vshape = tuple(source.spec.value_shape)
+        probe = DeltaBatch(
+            np.zeros(1, np.int64),
+            np.zeros((1,) + vshape, source.spec.value_dtype),
+            np.zeros(1, np.int64))
+        for i in range(max_ticks):
+            self.push(source, probe)
+            r = self.tick(sync=False).block()
+            if r.quiesced:
+                return i + 1
+        raise GraphError(
+            f"drain: {self.graph.name} not quiescent after {max_ticks} "
+            f"ticks (deferred residue not converging, or the loop region "
+            f"is genuinely divergent)")
+
     # -- host boundary out -------------------------------------------------
 
     def _note_forced_sync(self, context: str) -> None:

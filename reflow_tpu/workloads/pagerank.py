@@ -57,7 +57,14 @@ class PageRankGraph:
 
 
 def build_graph(n_nodes: int, *, damping: float = DAMPING, tol: float = 1e-4,
-                arena_capacity: Optional[int] = None) -> PageRankGraph:
+                arena_capacity: Optional[int] = None,
+                defer_passes: Optional[int] = None) -> PageRankGraph:
+    """``defer_passes`` opts the rank loop into cross-tick residual
+    deferral (docs/guide.md "Deferred fixpoint"): each tick runs at most
+    that many fixpoint passes, carrying un-propagated rank deltas to the
+    next tick. Ranks then lag full convergence by the in-flight mass —
+    bounded by d/(1-d) · ||resid||₁ — and ``DirtyScheduler.drain``
+    flushes to the quiescent fixpoint."""
     rank_spec = Spec((), np.float32, key_space=n_nodes, unique=True)
     scalar = Spec((), np.float32, key_space=n_nodes)
     edge_spec = Spec((2,), np.float32, key_space=n_nodes)
@@ -85,7 +92,7 @@ def build_graph(n_nodes: int, *, damping: float = DAMPING, tol: float = 1e-4,
     everything = g.union(teleport, damped, name="teleport_plus_contribs")
     new_rank = g.reduce(everything, "sum", tol=tol, name="rank",
                         spec=rank_spec)
-    g.close_loop(ranks, new_rank)
+    g.close_loop(ranks, new_rank, defer_passes=defer_passes)
     return PageRankGraph(g, ranks, teleport, edges, j, new_rank)
 
 

@@ -40,7 +40,7 @@ def _edge_merge(k, x, vb):
     return jnp.stack([vb[:, 0], x * vb[:, 1]], axis=-1)
 
 
-def build_linear_loop(rng: np.random.Generator):
+def build_linear_loop(rng: np.random.Generator, defer=None):
     """Random declared-linear region; returns (graph, base, edges, reduce,
     uses_groupby)."""
     rank_spec = Spec((), np.float32, key_space=K, unique=True)
@@ -74,8 +74,15 @@ def build_linear_loop(rng: np.random.Generator):
                      vectorized=True, linear=True)
     u = g.union(node, base)
     red = g.reduce(u, "sum", tol=1e-4, spec=rank_spec)
-    g.close_loop(x, red)
+    g.close_loop(x, red, defer_passes=defer)
     return g, base, edges, red, use_groupby, map_cs
+
+
+#: keys [K - EDGE_FREE, K) never receive edge contributions: their
+#: emissions exist iff their base row does, so base retractions on them
+#: exercise true emission-vanish (and reinsert) transitions — including
+#: retractions IN FLIGHT under the deferred schedules
+EDGE_FREE = 8
 
 
 def edge_rows(rng, n, use_groupby, map_scale, mass):
@@ -84,7 +91,7 @@ def edge_rows(rng, n, use_groupby, map_scale, mass):
     edges — ``mass`` tracks what's already spent), so the loop contracts
     even as churn adds edges. Updates ``mass`` in place."""
     src = rng.integers(0, K, n)
-    dst = rng.integers(0, K, n)
+    dst = rng.integers(0, K - EDGE_FREE, n)
     raw = rng.random(n) + 0.1
     per_src = np.zeros(K)
     np.add.at(per_src, src, raw)
@@ -99,13 +106,16 @@ def edge_rows(rng, n, use_groupby, map_scale, mass):
     return src.astype(np.int64), vals
 
 
-def drive(executor, g, base, edges, red, ticks):
+def drive(executor, g, base, edges, red, ticks, deferred=False):
     sched = DirtyScheduler(g, executor, max_loop_iters=500)
     for tick in ticks:
         for src_node, batch in tick:
             sched.push({"base": base, "edges": edges}[src_node], batch)
-        r = sched.tick()
-        assert r.quiesced
+        r = sched.tick(sync=not deferred)
+        if not deferred:
+            assert r.quiesced
+    if deferred:
+        sched.drain(edges)
     return sched.read_table(red)
 
 
@@ -118,6 +128,8 @@ def make_ticks(rng, use_groupby, map_scale):
     ticks = [[("base", DeltaBatch(bkeys, bvals, np.ones(K, np.int64))),
               ("edges", DeltaBatch(src, vals, w))]]
     live = list(range(N_EDGES))
+    #: retracted edge-free base keys (their emission is gone while here)
+    gone: set = set()
     for _ in range(CHURN_TICKS):
         n_ch = int(rng.integers(4, 20))
         pick = rng.choice(len(live), size=min(n_ch, len(live)),
@@ -137,7 +149,17 @@ def make_ticks(rng, use_groupby, map_scale):
         vals = np.concatenate([vals, nvals])
         live.extend(range(len(src) - len(idx), len(src)))
         insert = DeltaBatch(nsrc, nvals, np.ones(len(idx), np.int64))
-        ticks.append([("edges", DeltaBatch.concat([retract, insert]))])
+        # toggle one edge-free key's base row: a retraction makes that
+        # key's emission VANISH (no contributions reach it), a reinsert
+        # brings it back — covering retraction-in-flight under deferral
+        k_t = int(rng.integers(K - EDGE_FREE, K))
+        w_t = -1 if k_t not in gone else 1
+        (gone.discard if k_t in gone else gone.add)(k_t)
+        ticks.append([
+            ("edges", DeltaBatch.concat([retract, insert])),
+            ("base", DeltaBatch(np.array([k_t], np.int64),
+                                bvals[k_t:k_t + 1],
+                                np.array([w_t], np.int64)))])
     return ticks
 
 
@@ -164,23 +186,74 @@ def test_random_linear_loop_all_programs_agree(seed):
 
     tables = {}
     execs = {
-        "cpu": lambda: CpuExecutor(),
-        "tpu_linear": lambda: TpuExecutor(),
-        "tpu_row": lambda: TpuExecutor(linear_fixpoint=False),
-        "sharded": lambda: ShardedTpuExecutor(make_mesh(8)),
+        "cpu": (lambda: CpuExecutor(), None),
+        "tpu_linear": (lambda: TpuExecutor(), None),
+        "tpu_row": (lambda: TpuExecutor(linear_fixpoint=False), None),
+        "sharded": (lambda: ShardedTpuExecutor(make_mesh(8)), None),
+        # cross-tick residual deferral: capped passes/tick + drain must
+        # land on the same fixpoint (covers retraction-in-flight via the
+        # edge-free base-key toggles)
+        "tpu_defer1": (lambda: TpuExecutor(), 1),
+        "sharded_defer2": (lambda: ShardedTpuExecutor(make_mesh(8)), 2),
     }
-    for name, mk in execs.items():
-        g, base, edges, red, _, _ = fresh()
+    for name, (mk, defer) in execs.items():
+        g, base, edges, red, _, _ = fresh() if defer is None else \
+            build_linear_loop(np.random.default_rng(graph_seed),
+                              defer=defer)
         ex = mk()
-        tables[name] = drive(ex, g, base, edges, red, ticks)
+        tables[name] = drive(ex, g, base, edges, red, ticks,
+                             deferred=defer is not None)
         if name == "tpu_linear":
             assert ex._linear_structure is not None, (
                 f"seed {seed}: analyze_linear did not match the region "
                 f"(groupby={use_groupby}, maps={map_cs})")
 
     ref = as_vec(tables["cpu"])
-    for name in ("tpu_linear", "tpu_row", "sharded"):
+    for name in ("tpu_linear", "tpu_row", "sharded", "tpu_defer1",
+                 "sharded_defer2"):
         np.testing.assert_allclose(
             as_vec(tables[name]), ref, atol=2e-3,
             err_msg=f"seed {seed}: {name} diverges "
                     f"(groupby={use_groupby}, maps={map_cs})")
+
+
+def test_violated_stable_key_raises_sticky_error():
+    """ADVICE r4: a GroupBy declaring stable_key=True whose key_fn in fact
+    reads the loop value must fail LOUDLY (the dense destination-sorted
+    tier checks its precomputed destinations against the runtime keys and
+    routes a mismatch into the join's sticky error) — never silently
+    produce tier-selection-dependent ranks."""
+    rank_spec = Spec((), np.float32, key_space=K, unique=True)
+    scalar = Spec((), np.float32, key_space=K)
+    edge2 = Spec((2,), np.float32, key_space=K)
+
+    def bad_key(k, v):
+        # at CSR build the loop value is zeroed -> v[:, 1] == 0 -> dst;
+        # at runtime v[:, 1] = x*coef != 0 -> dst + 1: a genuine
+        # loop-value-dependent key, misdeclared stable
+        import jax.numpy as jnp
+        return (v[:, 0] + (jnp.abs(v[:, 1]) > 1e-12)).astype("int32") % K
+
+    g = FlowGraph("badstable")
+    base = g.source("base", scalar)
+    edges = g.source("edges", edge2)
+    x = g.loop("x", rank_spec)
+    j = g.join(x, edges, merge=_edge_merge, spec=edge2, linear_left=True,
+               arena_capacity=1 << 10)
+    gb = g.group_by(j, key_fn=bad_key, value_fn=lambda k, v: v[:, 1],
+                    vectorized=True, spec=scalar, stable_key=True)
+    u = g.union(gb, base)
+    red = g.reduce(u, "sum", tol=1e-4, spec=rank_spec)
+    g.close_loop(x, red)
+
+    sched = DirtyScheduler(g, TpuExecutor(), max_loop_iters=200)
+    keys = np.arange(K, dtype=np.int64)
+    sched.push(base, DeltaBatch(keys, np.full(K, 0.5, np.float32),
+                                np.ones(K, np.int64)))
+    src = np.arange(K, dtype=np.int64)
+    vals = np.stack([((src + 1) % K).astype(np.float32),
+                     np.full(K, 0.5, np.float32)], axis=1)
+    sched.push(edges, DeltaBatch(src, vals, np.ones(K, np.int64)))
+    with pytest.raises(RuntimeError, match="stable_key"):
+        sched.tick()
+        sched.tick()  # in case the error latches a tick later

@@ -181,3 +181,158 @@ def test_macro_tick_fallback_cpu_executor():
         sched2.tick()
     assert (sched.read_table(pg.new_rank)
             == sched2.read_table(pg2.new_rank))
+
+
+# -- deferred fixpoint (cross-tick residual deferral, VERDICT r4 #1) -------
+
+def _run_deferred(executor_name, defer, seed=21, churn_ticks=6,
+                  drain=True, arena=4096, settle=False):
+    web = pagerank.WebGraph.random(N, E, seed=seed)
+    pg = pagerank.build_graph(web.n_nodes, tol=TOL, arena_capacity=arena,
+                              defer_passes=defer)
+    sched = DirtyScheduler(pg.graph, get_executor(executor_name),
+                           max_loop_iters=500)
+    sched.push(pg.teleport, pagerank.teleport_batch(web.n_nodes))
+    sched.push(pg.edges, web.initial_batch())
+    sched.tick(sync=False)
+    if settle:
+        # converge the cold build before streaming churn: mid-stream
+        # accuracy then reflects steady-state churn-tracking lag, not
+        # the (deliberately amortized) initial convergence
+        sched.drain(pg.edges)
+    for _ in range(churn_ticks):
+        sched.push(pg.edges, web.churn(0.05))
+        sched.tick(sync=False)
+    if drain:
+        sched.drain(pg.edges)
+    return web, pg, sched
+
+
+def test_deferred_drain_matches_reference():
+    """defer_passes caps loop passes per tick; drain() flushes the carried
+    residue to the same fixpoint a quiescent schedule reaches (within the
+    tol-lag band of the independent NumPy oracle)."""
+    for defer in (1, 2, 4):
+        web, pg, sched = _run_deferred("tpu", defer)
+        ranks = as_array(sched.read_table(pg.new_rank), N)
+        ref = pagerank.reference_ranks(web)
+        np.testing.assert_allclose(ranks, ref, atol=5e-4)
+
+
+def test_deferred_left_table_consistency():
+    """After drain the Join's folded left table must equal the Reduce's
+    emitted table exactly — the deferred left-table patch (A = emitted -
+    resid) reduces to the quiescent formula at resid == 0."""
+    web, pg, sched = _run_deferred("tpu", 2)
+    jt = sched.read_table(pg.join)
+    rt = sched.read_table(pg.new_rank)
+    assert set(jt) == set(rt)
+    for k in rt:
+        assert jt[k] == rt[k]
+
+
+def test_deferred_mid_stream_accuracy_bounded():
+    """Without drain, ranks lag full convergence by the in-flight mass;
+    for PageRank the lag is geometrically damped (d/(1-d) amplification),
+    so mid-stream views stay within a small multiple of the drained
+    band. This is the accuracy contract of docs/guide.md."""
+    web, pg, sched = _run_deferred("tpu", 2, drain=False, settle=True)
+    ranks = as_array(sched.read_table(pg.new_rank), N)
+    ref = pagerank.reference_ranks(web)
+    mid_err = np.abs(ranks - ref).max()
+    sched.drain(pg.edges)
+    drained = as_array(sched.read_table(pg.new_rank), N)
+    drained_err = np.abs(drained - ref).max()
+    # 5% churn/tick at defer=2 on a 64-node graph is a brutal regime (the
+    # whole rank vector reshuffles every few ticks); the contract is that
+    # the lag stays within a small multiple of the per-tick injected mass
+    # and collapses to the drained band on drain
+    assert mid_err < 0.2, mid_err
+    assert drained_err < 5e-4, drained_err
+
+
+def test_deferred_sharded_matches_tpu():
+    """The sharded executor runs the identical deferred schedule inside
+    one shard_map region — results agree with the single-device program
+    to f32 reduction-order noise."""
+    web_a, pg_a, sched_a = _run_deferred("tpu", 2)
+    web_b, pg_b, sched_b = _run_deferred("sharded", 2)
+    assert np.array_equal(web_a.dst, web_b.dst)
+    a = as_array(sched_a.read_table(pg_a.new_rank), N)
+    b = as_array(sched_b.read_table(pg_b.new_rank), N)
+    np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_deferred_checkpoint_roundtrip_with_live_residue():
+    """The carried residue is SEMANTIC state: a checkpoint taken
+    mid-stream (residue live) must restore it, or in-flight rank mass
+    would be silently lost. Restore drops the derived CSR cache, so
+    agreement is to f32 reduction-order noise, not bitwise."""
+    import tempfile
+
+    from reflow_tpu.utils.checkpoint import load_checkpoint, save_checkpoint
+
+    web = pagerank.WebGraph.random(N, E, seed=23)
+    pg = pagerank.build_graph(N, tol=TOL, arena_capacity=4096,
+                              defer_passes=2)
+    sched = DirtyScheduler(pg.graph, get_executor("tpu"), max_loop_iters=500)
+    sched.push(pg.teleport, pagerank.teleport_batch(N))
+    sched.push(pg.edges, web.initial_batch())
+    sched.tick(sync=False)
+    churns = [web.churn(0.05) for _ in range(6)]
+    for b in churns[:3]:
+        sched.push(pg.edges, b)
+        sched.tick(sync=False)
+    with tempfile.TemporaryDirectory() as td:
+        save_checkpoint(sched, td)
+        pg2 = pagerank.build_graph(N, tol=TOL, arena_capacity=4096,
+                                   defer_passes=2)
+        sched2 = DirtyScheduler(pg2.graph, get_executor("tpu"),
+                                max_loop_iters=500)
+        load_checkpoint(sched2, td)
+    # the restored residue must be live (mid-stream, defer=2)
+    resid = np.asarray(sched2.executor.states[pg2.ranks.id]["resid"])
+    assert np.any(resid != 0)
+    for sch, pgx in ((sched, pg), (sched2, pg2)):
+        for b in churns[3:]:
+            sch.push(pgx.edges, b)
+            sch.tick(sync=False)
+        sch.drain(pgx.edges)
+    a = as_array(sched.read_table(pg.new_rank), N)
+    b = as_array(sched2.read_table(pg2.new_rank), N)
+    np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_deferred_macro_tick_matches_sequential():
+    """tick_many carries the residue through its lax.scan (it lives in
+    the op-state carry): K fused deferred ticks == K sequential streaming
+    deferred ticks, bitwise."""
+    web_a = pagerank.WebGraph.random(N, E, seed=29)
+    web_b = pagerank.WebGraph.random(N, E, seed=29)
+
+    def prep(web):
+        pg = pagerank.build_graph(web.n_nodes, tol=TOL, arena_capacity=4096,
+                                  defer_passes=2)
+        sched = DirtyScheduler(pg.graph, get_executor("tpu"),
+                               max_loop_iters=500)
+        sched.push(pg.teleport, pagerank.teleport_batch(web.n_nodes))
+        sched.push(pg.edges, web.initial_batch())
+        sched.tick(sync=False)
+        return pg, sched, [web.churn(0.05) for _ in range(3)]
+
+    pg_a, sched_a, churns_a = prep(web_a)
+    for b in churns_a:
+        sched_a.push(pg_a.edges, b)
+        sched_a.tick(sync=False)
+
+    pg_b, sched_b, churns_b = prep(web_b)
+    sched_b.tick_many([{pg_b.edges: b} for b in churns_b]).block()
+
+    ranks_a = sched_a.read_table(pg_a.new_rank)
+    ranks_b = sched_b.read_table(pg_b.new_rank)
+    assert set(ranks_a) == set(ranks_b)
+    for k in ranks_a:
+        assert ranks_a[k] == ranks_b[k]
+    ra = np.asarray(sched_a.executor.states[pg_a.ranks.id]["resid"])
+    rb = np.asarray(sched_b.executor.states[pg_b.ranks.id]["resid"])
+    assert np.array_equal(ra, rb)
