@@ -191,7 +191,11 @@ def run_pagerank_tpu_child(defer=None) -> dict:
         # tunnel into degraded dispatch — that's the regime the median
         # window lands in anyway (window 1's pipelined mode is the
         # documented outlier), so the windows stay comparable.
-        cold_drain_ticks = sched.drain(pr.edges)
+        # probe at the churn batch size so drain ticks reuse the churn
+        # program signature (a 1-row probe's 64-capacity bucket would
+        # compile a fresh program, ~60s on the tunnel)
+        n_churn = 2 * max(1, int(p["churn"] * p["n_edges"]))
+        cold_drain_ticks = sched.drain(pr.edges, probe_rows=n_churn)
         log(f"cold-build residue drained in {cold_drain_ticks} ticks")
 
     # NOTE on tick_many (the lax.scan macro-tick): it amortizes the
@@ -255,7 +259,8 @@ def run_pagerank_tpu_child(defer=None) -> dict:
         mid = _pg.ranks_to_array(sched.read_table(pr.new_rank),
                                  p["n_nodes"])
         t_dr = time.perf_counter()
-        drain_ticks = sched.drain(pr.edges)
+        drain_ticks = sched.drain(
+            pr.edges, probe_rows=2 * max(1, int(p["churn"] * p["n_edges"])))
         drain_s = time.perf_counter() - t_dr
         drained = _pg.ranks_to_array(sched.read_table(pr.new_rank),
                                      p["n_nodes"])

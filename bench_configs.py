@@ -378,13 +378,19 @@ def cfg4_knn(smoke: bool, log) -> None:
             preload = min(int(os.environ.get("REFLOW_BENCH_KNN_PRELOAD",
                                              cap_preload)), cap_preload)
 
-        # bf16 embeddings + native-bf16 MXU scoring: halves the corpus
-        # HBM residency AND the per-insert-tick host upload (the
-        # bandwidth-bound cost of the re-index flow); ~1e-3 relative
-        # score error, standard ANN practice
+        # int8 quantized corpus ingest (VERDICT r4 #3a): round(unit*127)
+        # on the wire — 1 byte/dim, HALF the bf16 wire+HBM cost that was
+        # the measured binding constraint of this config — dequantized to
+        # bf16 at score time on chip (kernels.topk.score_form; recall
+        # bound tested in tests/test_knn.py). Queries stay bf16 (their
+        # upload is negligible). REFLOW_BENCH_KNN_DTYPE=bf16 restores
+        # the previous wire format for A/B runs.
         import jax.numpy as jnp
+        wire = os.environ.get("REFLOW_BENCH_KNN_DTYPE", "int8")
+        doc_dtype = jnp.int8 if wire == "int8" else jnp.bfloat16
         kg = knn.build_graph(Q, D, dim, k, scan_chunk=chunk,
-                             dtype=jnp.bfloat16, precision="default")
+                             dtype=jnp.bfloat16, doc_dtype=doc_dtype,
+                             precision="default")
         # generator-only here: the corpus preload below is device-made, so
         # store.vecs mirrors ONLY the measured host-boundary inserts (never
         # use store.reference_topk / len(store.vecs) in this config)
@@ -404,7 +410,7 @@ def cfg4_knn(smoke: bool, log) -> None:
             # keys the device would silently drop
             ids = np.arange(next_id, next_id + n) % D
             next_id += n
-            return store.insert_batch(ids)
+            return store.insert_batch(ids, quantize=(wire == "int8"))
 
         # corpus preload GENERATED ON DEVICE: the preload is bench
         # fixture setup (the measured flow is the insert windows below,
@@ -426,8 +432,15 @@ def cfg4_knn(smoke: bool, log) -> None:
             kk = jax.random.fold_in(jax.random.PRNGKey(3), seed)
             vals = jax.random.normal(kk, (big, dim), jnp.float32)
             keys = (base + jnp.arange(big, dtype=jnp.int32)) % D
-            return DeviceDelta(keys, jnp.asarray(vals, jnp.bfloat16),
-                               jnp.ones((big,), jnp.int32))
+            if doc_dtype == jnp.int8:
+                # device-side form of workloads.knn.quantize_int8
+                nrm = jnp.sqrt(jnp.sum(vals * vals, axis=1, keepdims=True))
+                unit = vals / jnp.maximum(nrm, 1e-30)
+                rows = jnp.clip(jnp.round(unit * 127.0), -127, 127
+                                ).astype(jnp.int8)
+            else:
+                rows = jnp.asarray(vals, doc_dtype)
+            return DeviceDelta(keys, rows, jnp.ones((big,), jnp.int32))
 
         def retract(ids):
             # device knn retraction clears the id's live bit and never
@@ -487,12 +500,16 @@ def cfg4_knn(smoke: bool, log) -> None:
         re_ins = min(max(next_id - D, 0), per_tick // 8)
         live_rows = (min(next_id, D) - (per_tick // 8 - re_ins)
                      - per_tick // 8)
+        wire_bytes = 1 if doc_dtype == jnp.int8 else 2
         _record(log, "4_knn", {
             "executor": "tpu",
             "queries": Q,
             "corpus": live_rows,
             "corpus_capacity": D,
             "dim": dim, "k": k,
+            "embed_wire_dtype": wire,
+            "upload_mb_per_tick": round(
+                per_tick * dim * wire_bytes / 1e6, 2),
             "preload_dispatch_s": round(preload_s, 1),
             "delta_ops_per_s": round(dops / wall),
             "insert_tick_ms_amortized": round(1e3 * wall / 6, 1),
@@ -534,9 +551,28 @@ def cfg5_image_embed(smoke: bool, log) -> None:
         params = init_vit(0, **cfg)
         params["_cfg"] = cfg
 
-        ig = image_embed.build_graph(n_images, n_groups, params)
-        mesh = make_mesh()  # all local devices (1 on the real chip)
-        sched = DirtyScheduler(ig.graph, ShardedTpuExecutor(mesh))
+        # REFLOW_BENCH_MODEL_AXIS=m: tensor-parallel the ViT over an
+        # m-way model axis (2-D delta x model mesh, VERDICT r4 #8) —
+        # params shard 1/m per device; needs >= m local devices. The
+        # single-chip tunnel default is the 1-D data mesh.
+        m_tp = int(_os.environ.get("REFLOW_BENCH_MODEL_AXIS", 0) or 0)
+        n_dev = len(jax.devices())
+        if m_tp >= 2 and n_dev >= m_tp and n_dev % m_tp == 0:
+            from reflow_tpu.parallel.mesh import make_model_mesh
+            mesh = make_model_mesh(n_dev // m_tp, m_tp)
+            ex = ShardedTpuExecutor(mesh, model_axis="model")
+            ig = image_embed.build_graph(n_images, n_groups, params,
+                                         model_axis="model")
+        else:
+            mesh = make_mesh()  # all local devices (1 on the real chip)
+            ex = ShardedTpuExecutor(mesh)
+            ig = image_embed.build_graph(n_images, n_groups, params)
+        sched = DirtyScheduler(ig.graph, ex)
+        embed_node = next(n for n in ig.graph.nodes if n.name == "embed")
+        param_mb_dev = sum(
+            s.data.nbytes for leaf in jax.tree.leaves(
+                ex.states[embed_node.id]["params"])
+            for s in leaf.addressable_shards[:1]) / 1e6
         stream = image_embed.ImageStream(params, seed=5)
         next_id = 0
 
@@ -572,6 +608,55 @@ def cfg5_image_embed(smoke: bool, log) -> None:
 
         wall, dwall, dops, _ = _median_window(
             run_image_window, log, "5_image_embed")
+
+        # DEVICE-BOUND window (VERDICT r4 #3b): the same ingestion flow
+        # with pixel batches GENERATED ON CHIP (the cfg4 preload trick),
+        # so the record separates the model-compute ceiling from the
+        # tunnel-upload ceiling — upload per tick drops from ~38MB to
+        # the dispatch bytes of one seed scalar
+        import jax.numpy as jnp
+        from functools import partial
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from reflow_tpu.executors.device_delta import DeviceDelta
+
+        flat = cfg["img"] * cfg["img"] * cfg["chans"]
+        row_sh = NamedSharding(
+            mesh, P(mesh.axis_names if len(mesh.axis_names) > 1
+                    else mesh.axis_names[0]))
+
+        @partial(jax.jit,
+                 out_shardings=DeviceDelta(row_sh, row_sh, row_sh))
+        def gen_imgs(seed, base):
+            kk = jax.random.fold_in(jax.random.PRNGKey(11), seed)
+            pix = jax.random.randint(kk, (per_tick, flat), 0, 256,
+                                     jnp.int32).astype(jnp.uint8)
+            ids = base + jnp.arange(per_tick, dtype=jnp.int32)
+            grp = (ids % n_groups).astype(jnp.uint8)
+            vals = jnp.concatenate([grp[:, None], pix], axis=1)
+            return DeviceDelta(ids % n_images, vals,
+                               jnp.ones((per_tick,), jnp.int32))
+
+        dev_seed = 0
+
+        def dev_tick():
+            nonlocal dev_seed, next_id
+            sched.push(ig.images, gen_imgs(np.int32(dev_seed),
+                                           np.int32(next_id % n_images)))
+            dev_seed += 1
+            next_id += per_tick
+            sched.tick(sync=False)
+
+        dev_tick()                      # absorb the device-gen shape
+        _sync_read(sched.executor)
+        t0 = time.perf_counter()
+        for _ in range(ticks):
+            dev_tick()
+        _sync_read(sched.executor)
+        dev_wall = time.perf_counter() - t0
+        sched.executor.check_errors()
+
         # a group move: retract/insert pair through the model. Post-window
         # wall carries one degraded-tunnel sync — conservative, never an
         # enqueue time. Group 2 (absorption already moved image 0 to 1):
@@ -592,6 +677,8 @@ def cfg5_image_embed(smoke: bool, log) -> None:
         _record(log, "5_image_embed", {
             "executor": "sharded",
             "mesh_devices": len(mesh.devices.ravel()),
+            "model_axis": m_tp if m_tp >= 2 else None,
+            "param_mb_per_device": round(param_mb_dev, 1),
             "model": "vit_tiny" if smoke else "vit_b_16",
             "images_per_tick": per_tick,
             "delta_ops_per_s": round(dops / wall, 1),
@@ -607,5 +694,11 @@ def cfg5_image_embed(smoke: bool, log) -> None:
             "upload_mb_per_tick": round(upload_mb, 1),
             "dispatch_ms_total": round(1e3 * dwall, 1),
             "move_tick_ms": round(1e3 * move_wall, 1),
+            # tunnel factored out: on-chip-generated pixels, ~0MB upload
+            "images_per_s_device_bound": round(
+                per_tick * ticks / dev_wall, 2),
+            "mfu_pct_device_bound": round(
+                100 * (per_tick * ticks / dev_wall) * flops
+                / (peak * len(mesh.devices.ravel())), 2),
         })
     run()

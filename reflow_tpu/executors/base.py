@@ -51,9 +51,13 @@ class Executor(abc.ABC):
         """Optionally run an ENTIRE tick (all fixpoint passes) in one call.
 
         Returns ``({sink_id: [batches]}, passes, loop_rows, quiesced,
-        extra_dirty_node_ids)`` or None when unsupported — the scheduler
-        then drives passes itself. Executors that can fuse the loop on
-        device (TpuExecutor via ``lax.while_loop``) override this.
+        extra_dirty_node_ids, leftover)`` or None when unsupported — the
+        scheduler then drives passes itself. ``leftover`` maps loop node
+        ids to in-flight loop-delta batches of a tick that halted at
+        ``max_iters``: the scheduler stashes them as pending so the
+        paused iteration RESUMES next tick (empty when quiescent).
+        Executors that can fuse the loop on device (TpuExecutor via
+        ``lax.while_loop``) override this.
 
         ``sync=False`` permits the scalar observability fields (passes,
         loop_rows, quiesced) to come back as device values without

@@ -184,7 +184,8 @@ def make_scan_program(tick_fn):
 
     def scan_fn(op_states, ing_stack):
         def body(states, ing):
-            states2, sink_eg, iters, rows, conv = tick_fn(states, ing)
+            states2, sink_eg, _carry, iters, rows, conv = tick_fn(states,
+                                                                  ing)
             assert not sink_eg, "macro-tick requires a sink-free graph"
             return states2, (iters, rows, conv)
 
@@ -303,7 +304,10 @@ class FixpointProgram(_MacroTickMixin):
                     batches.append(eg_b[sid])
                 if batches:
                     sink_egress[sid] = tuple(batches)
-            return states, sink_egress, iters, rows, converged
+            # the final carry rides out so a max_iters halt can PAUSE
+            # instead of dropping in-flight loop deltas (the scheduler
+            # stashes live carries as pending; all-dead when converged)
+            return states, sink_egress, dict(carry), iters, rows, converged
 
         # donate the state pytree: ticks update arenas/tables in place
         # instead of copying them (the executor drops old refs on return)
@@ -311,6 +315,6 @@ class FixpointProgram(_MacroTickMixin):
         self._fn = jax.jit(tick_fn, donate_argnums=0)
 
     def __call__(self, op_states, dev_ingress):
-        """-> (states', {sink_id: (DeviceDelta, ...)}, iters, loop_rows,
-        converged)."""
+        """-> (states', {sink_id: (DeviceDelta, ...)}, {loop_id: carry},
+        iters, loop_rows, converged)."""
         return self._fn(op_states, dev_ingress)

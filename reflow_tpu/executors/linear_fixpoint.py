@@ -875,13 +875,18 @@ class LinearFixpointProgram(_MacroTickMixin):
         return csr0
 
     def __call__(self, op_states, dev_ingress):
-        """-> (states', {sink_id: (DeviceDelta, ...)}, iters, loop_rows,
-        converged) — the FixpointProgram call contract. The CSR cache
-        threads through invisibly (held on the executor, donated here)."""
+        """-> (states', {sink_id: (DeviceDelta, ...)}, carry, iters,
+        loop_rows, converged) — the FixpointProgram call contract. The
+        CSR cache threads through invisibly (held on the executor,
+        donated here). carry is None: this program's in-flight loop
+        state is dense observables, carried in the loop node's ``resid``
+        state under defer_passes (resumable by construction); a
+        max_iters halt WITHOUT defer_passes is non-resumable here
+        (use defer_passes when halting mid-fixpoint is expected)."""
         states, csr, eg, iters, rows, conv = self._fn(
             op_states, self._take_csr(), dev_ingress)
         self._executor._csr_cache[self._join_id] = csr
-        return states, eg, iters, rows, conv
+        return states, eg, None, iters, rows, conv
 
     def call_many(self, op_states, ing_stack, n_ticks: int):
         """K ticks in ONE device execution, CSR cache carried through the

@@ -144,14 +144,20 @@ class TpuExecutor(Executor):
                 from reflow_tpu.executors.lowerings import knn_state
                 self.states[node.id] = knn_state(op, *in_specs)
             elif op.kind == "join":
-                if not in_specs[0].unique:
-                    raise GraphError(
-                        f"{node}: device Join requires a unique-keyed left "
-                        f"input (Spec.unique=True, e.g. a Reduce output)")
                 if op.merge is None:
-                    raise GraphError(
-                        f"{node}: device Join requires an explicit "
-                        f"vectorized merge(keys, va, vb) function")
+                    # the default merge lowers to the flattened
+                    # concatenation of (va, vb) — the device encoding of
+                    # the host oracle's tuple; the out Spec must size it
+                    import numpy as _np
+                    flat = int(_np.prod(in_specs[0].value_shape or (1,))
+                               ) + int(_np.prod(in_specs[1].value_shape
+                                                or (1,)))
+                    got = int(_np.prod(node.spec.value_shape or (1,)))
+                    if got != flat:
+                        raise GraphError(
+                            f"{node}: default-merge device Join needs a "
+                            f"spec with {flat} flat value elements "
+                            f"(va ++ vb), got {node.spec.value_shape}")
                 self.states[node.id] = join_state(op, in_specs[0], in_specs[1])
             else:
                 raise GraphError(f"{node}: no TPU lowering for {op.kind}")
@@ -235,16 +241,24 @@ class TpuExecutor(Executor):
                 list(st.exit_plan),
                 {n.id: 2 * n.inputs[0].spec.key_space for n in st.boundary})
 
-        new_states, sink_egress, iters, rows, converged = prog(
+        new_states, sink_egress, carry, iters, rows, converged = prog(
             dict(self.states), dev_ingress)
         self.states = new_states
         exit_passes = 1 if st.exit_plan else 0
+        leftover = {}
         if sync:
             iters = int(iters)
             passes = 1 + iters + exit_passes
             rows = int(rows)
             converged = bool(converged)
             looped = iters > 0
+            if not converged and carry:
+                # max_iters halt: hand the live carry back so the
+                # scheduler stashes it as pending — the halted iteration
+                # RESUMES on the next tick instead of silently dropping
+                # in-flight loop deltas (which would desync the join's
+                # left table from the reduce's emissions)
+                leftover = dict(carry)
         else:
             # LazyScalar, not eager jnp arithmetic: a per-tick scalar op
             # would dispatch an extra device execution (large fixed cost
@@ -253,13 +267,24 @@ class TpuExecutor(Executor):
 
             passes = LazyScalar(1 + exit_passes, iters)
             looped = True  # conservative dirty-set report
+            if carry:
+                # streaming mode cannot branch on the device-resident
+                # converged flag, so the ROW program's carry stashes
+                # UNCONDITIONALLY: a quiescent tick's carry is all
+                # weight-0 rows (a semantic no-op that keeps the next
+                # tick's ingress signature stable), and a max_iters halt
+                # resumes losslessly instead of silently desyncing the
+                # join's left table. The fused linear program returns
+                # carry=None (its in-flight state is the defer resid),
+                # so the streaming headline path is untouched.
+                leftover = dict(carry)
         # nodes the fused passes executed beyond the phase-A plan (for the
         # scheduler's dirty-set observability): region + exit nodes, which
         # only ran if the loop actually iterated
         extra_dirty = (set(st.region_ids) | {n.id for n in st.exit_plan}
                        if looped else set())
         return ({sid: list(batches) for sid, batches in sink_egress.items()},
-                passes, rows, converged, extra_dirty)
+                passes, rows, converged, extra_dirty, leftover)
 
     def run_tick_fixpoint_many(self, plan, feeds, max_iters):
         """K consecutive ticks as ONE device execution (the macro-tick).
@@ -268,7 +293,13 @@ class TpuExecutor(Executor):
         with identical node sets and identical padded capacities. Only
         sink-free graphs qualify (sink egress would need per-tick host
         materialization): iterative graphs scan the fused fixpoint
-        program, loop-free graphs scan the plain pass program. Returns
+        program, loop-free graphs scan the plain pass program. NOTE:
+        the scan discards per-tick fixpoint carries between iterations,
+        so a ROW-program tick that halts at max_iters inside a
+        macro-tick does NOT pause/resume (its conv flag comes back
+        False at block() — size max_loop_iters to quiesce, or stream
+        per-tick; the fused linear program's defer resid is in-state
+        and carries fine). Returns
         ``(passes_base, iters, rows, converged, extra_dirty)`` with any
         per-tick scalars device-resident (zero readbacks — the streaming
         fast path), or None when the graph/feeds don't fit (caller falls
@@ -490,16 +521,18 @@ class TpuExecutor(Executor):
                     "is invalid — re-run on the CPU executor or widen "
                     "the buffer")
         if node.kind == "op" and node.op.kind == "join":
-            return ("join sticky error: the arena overflowed (live rows + "
+            return ("join sticky error: an arena overflowed (live rows + "
                     "appends exceeded capacity even after in-program "
-                    "compaction — raise arena_capacity); or, under a sharded "
-                    "executor, sparse routing overflowed its per-destination "
-                    "budget (key skew — raise delta capacity or rebalance "
-                    "the key space); or a downstream GroupBy's "
-                    "stable_key=True declaration was violated (its key_fn "
-                    "read the loop value — the fused fixpoint's dense tier "
-                    "caught a precomputed/runtime destination mismatch); "
-                    "this tick's state is invalid")
+                    "compaction — raise arena_capacity / "
+                    "left_arena_capacity); or a multiset-left product "
+                    "exceeded its pair budget (raise product_slack); or, "
+                    "under a sharded executor, sparse routing overflowed "
+                    "its per-destination budget (key skew — raise delta "
+                    "capacity or rebalance the key space); or a downstream "
+                    "GroupBy's stable_key=True declaration was violated "
+                    "(its key_fn read the loop value — the fused fixpoint's "
+                    "dense tier caught a precomputed/runtime destination "
+                    "mismatch); this tick's state is invalid")
         return ("sticky device error flag set (sparse-route overflow: key "
                 "skew exceeded the ROUTE_SLACK per-destination budget); "
                 "this tick's state is invalid — raise the delta capacity "
@@ -522,6 +555,10 @@ class TpuExecutor(Executor):
         if node.op.kind == "join":
             if "error" in st and bool(st["error"]):
                 raise RuntimeError(f"{node}: {self._error_reason(node)}")
+            if "lkeys" in st:
+                raise KeyError(
+                    f"{node}: a multiset-left join has no unique left "
+                    f"table to read; attach a sink to observe its output")
             lw = np.asarray(st["lw"])
             lval = np.asarray(st["lval"])
             keys = np.nonzero(lw > 0)[0]
@@ -562,6 +599,21 @@ class TpuExecutor(Executor):
                         f"{node}: a single tick's right-delta capacity "
                         f"({caps[1]} rows) exceeds the per-shard arena "
                         f"capacity {cap}; raise arena_capacity")
+                if not node.inputs[0].spec.unique:
+                    La = ((node.op.left_arena_capacity
+                           or node.op.arena_capacity)
+                          // self._arena_divisor)
+                    if caps[0] > La:
+                        raise GraphError(
+                            f"{node}: a single tick's left-delta capacity "
+                            f"({caps[0]} rows) exceeds the per-shard left "
+                            f"arena capacity {La}; raise "
+                            f"left_arena_capacity")
+                    # both products are budget-bounded pair enumerations
+                    outs_cap[node.id] = (node.op.product_slack
+                                         * (caps[0] + caps[1])
+                                         * self._arena_divisor)
+                    continue
                 # an absent left delta skips the arena sweep entirely;
                 # sharded: each of the n shards emits 2*R/n + caps[1] rows
                 # (the right delta is all_gather'd), so global egress is

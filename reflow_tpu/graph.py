@@ -158,9 +158,10 @@ class FlowGraph:
 
     def map(self, input: Node, fn: Callable, *, vectorized: bool = False,
             linear: bool = False, name: Optional[str] = None,
-            spec: Optional[Spec] = None, params=None) -> Node:
+            spec: Optional[Spec] = None, params=None,
+            param_specs=None) -> Node:
         op = Map(fn, vectorized=vectorized, linear=linear, out_spec=spec,
-                 params=params)
+                 params=params, param_specs=param_specs)
         return self.add_op(op, [input], name=name)
 
     def filter(self, input: Node, pred: Callable, *, vectorized: bool = False,

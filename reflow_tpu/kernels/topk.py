@@ -111,6 +111,21 @@ def topk(scores: jax.Array, k: int,
     return vals, idx.astype(jnp.int32)
 
 
+#: int8 embedding encoding: wire/table value is round(unit_vec * 127);
+#: cosine only needs direction, so the per-vector scale folds away
+INT8_EMBED_SCALE = 127.0
+
+
+def score_form(v: jax.Array) -> jax.Array:
+    """Compute-form of stored embeddings: int8 tables dequantize to bf16
+    at score time (wire/HBM stay 1 byte/dim); float tables pass
+    through."""
+    if v.dtype == jnp.int8:
+        return jnp.asarray(v, jnp.bfloat16) * jnp.bfloat16(
+            1.0 / INT8_EMBED_SCALE)
+    return v
+
+
 def chunked_corpus_topk(qvec: jax.Array, dvec: jax.Array, dlive: jax.Array,
                         k: int, chunk: int = 8192,
                         use_pallas: Optional[bool] = None,
@@ -134,7 +149,8 @@ def chunked_corpus_topk(qvec: jax.Array, dvec: jax.Array, dlive: jax.Array,
         lo = c * chunk
         blk = jax.lax.dynamic_slice_in_dim(dvec, lo, chunk, 0)
         live = jax.lax.dynamic_slice_in_dim(dlive, lo, chunk, 0)
-        s = jnp.dot(qvec, blk.T, preferred_element_type=jnp.float32,
+        s = jnp.dot(score_form(qvec), score_form(blk).T,
+                    preferred_element_type=jnp.float32,
                     precision=precision)
         s = jnp.where(live[None, :], s, NEG)
         cand_vals = jnp.concatenate([vals, s], axis=1)

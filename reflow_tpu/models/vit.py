@@ -19,7 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["init_vit", "vit_forward", "vit_flops", "VIT_B_16", "VIT_TINY"]
+__all__ = ["init_vit", "vit_forward", "vit_forward_tp", "vit_param_specs",
+           "vit_flops", "VIT_B_16", "VIT_TINY"]
 
 #: ViT-B/16 (the reference workload's extractor)
 VIT_B_16 = dict(img=224, chans=3, patch=16, dim=768, depth=12, heads=12,
@@ -123,5 +124,86 @@ def vit_forward(params: Dict, images: jax.Array) -> jax.Array:
         x = x + _attn(_ln(x, blk["ln1"]), blk, cfg["heads"])
         h = _dot(_ln(x, blk["ln2"]), blk["w1"]) + blk["b1"]
         x = x + _dot(jax.nn.gelu(h), blk["w2"]) + blk["b2"]
+    x = _ln(x, params["ln_f"])
+    return jnp.mean(x, axis=-2)
+
+
+# -- tensor-parallel forward (2-D delta x model mesh, VERDICT r4 #8) -------
+
+def vit_param_specs(cfg: Dict, model_axis: str = "model"):
+    """Per-leaf PartitionSpecs for Megatron-style tensor parallelism:
+    QKV and MLP-in column-sharded (heads / hidden split over the model
+    axis), attention-out and MLP-out row-sharded (their products
+    ``psum`` over the model axis in :func:`vit_forward_tp`); LNs,
+    biases of row-sharded layers, projection and positional tables
+    replicate. Matches the ``init_vit`` pytree minus ``_cfg``."""
+    from jax.sharding import PartitionSpec as P
+
+    col_w = P(None, model_axis)      # [in, out/m]
+    row_w = P(model_axis, None)      # [in/m, out]
+    rep = P()
+    block = {
+        "ln1": {"g": rep, "b": rep}, "ln2": {"g": rep, "b": rep},
+        "wq": col_w, "wk": col_w, "wv": col_w, "wo": row_w,
+        "w1": col_w, "b1": P(model_axis), "w2": row_w, "b2": rep,
+    }
+    return {
+        "proj_w": rep, "proj_b": rep, "pos": rep,
+        "ln_f": {"g": rep, "b": rep},
+        "blocks": [dict(block) for _ in range(cfg["depth"])],
+    }
+
+
+def _attn_tp(x, blk, heads_local, axis):
+    n, d = x.shape[-2], x.shape[-1]
+    dl = blk["wq"].shape[-1]                 # d/m local projection width
+    hd = dl // heads_local
+
+    def split(w):
+        y = _dot(x, w)                       # [.., n, d/m]
+        return y.reshape(*y.shape[:-1], heads_local, hd)
+
+    q, k, v = split(blk["wq"]), split(blk["wk"]), split(blk["wv"])
+    logits = jnp.einsum("...qhd,...khd->...hqk", q, k,
+                        preferred_element_type=jnp.float32) * (hd ** -0.5)
+    a = jax.nn.softmax(logits, axis=-1)
+    o = jnp.einsum("...hqk,...khd->...qhd", a, v,
+                   preferred_element_type=jnp.float32)
+    # row-sharded output projection: partial products sum over the mesh
+    part = _dot(o.reshape(*o.shape[:-2], dl), blk["wo"])
+    return jax.lax.psum(part, axis)
+
+
+def vit_forward_tp(params: Dict, images: jax.Array,
+                   axis: str = "model") -> jax.Array:
+    """Per-shard tensor-parallel forward: ``params`` holds this model
+    shard's leaves (``vit_param_specs`` layout — local head/hidden
+    slices), activations are replicated over the model axis, and each
+    block pays exactly two ``psum``s (attention-out, MLP-out) — the
+    Megatron schedule. Call inside ``shard_map`` over a mesh carrying
+    ``axis``; numerics match :func:`vit_forward` to f32 reduction-order
+    noise."""
+    cfg = params["_cfg"]
+    img, chans, patch = cfg["img"], cfg["chans"], cfg["patch"]
+    m = jax.lax.psum(1, axis)
+    if cfg["heads"] % m or cfg["dim"] % m or cfg["mlp_dim"] % m:
+        # silently-wrong attention otherwise: e.g. heads=12 over m=8
+        # passes every SHAPE check (dim 768 % 8 == 0) but fuses 1.5 true
+        # heads into each local one
+        raise ValueError(
+            f"model axis size {m} must divide heads={cfg['heads']}, "
+            f"dim={cfg['dim']}, and mlp_dim={cfg['mlp_dim']}")
+    heads_local = cfg["heads"] // m
+    b = images.shape[0]
+    x = images.reshape(b, img, img, chans).astype(jnp.float32)
+    g = img // patch
+    x = x.reshape(b, g, patch, g, patch, chans)
+    x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, g * g, patch * patch * chans)
+    x = _dot(x, params["proj_w"]) + params["proj_b"] + params["pos"]
+    for blk in params["blocks"]:
+        x = x + _attn_tp(_ln(x, blk["ln1"]), blk, heads_local, axis)
+        h = _dot(_ln(x, blk["ln2"]), blk["w1"]) + blk["b1"]
+        x = x + jax.lax.psum(_dot(jax.nn.gelu(h), blk["w2"]), axis) \
+            + blk["b2"]
     x = _ln(x, params["ln_f"])
     return jnp.mean(x, axis=-2)

@@ -69,9 +69,17 @@ class Map(Op):
 
     def __init__(self, fn: Callable, *, vectorized: bool = False,
                  linear: bool = False, out_spec: Optional[Spec] = None,
-                 params: Any = None):
+                 params: Any = None, param_specs: Any = None):
         self.fn = fn
         self.vectorized = vectorized
+        #: optional pytree of jax.sharding.PartitionSpec matching
+        #: ``params``: under a ShardedTpuExecutor with a model axis, the
+        #: params shard per these specs instead of replicating, and
+        #: ``fn`` receives its LOCAL shard inside shard_map — the fn is
+        #: then responsible for the model-axis collectives (e.g.
+        #: models.vit.vit_forward_tp's two psums per block). This is the
+        #: tensor-parallel seam for models too large for one chip's HBM.
+        self.param_specs = param_specs
         #: declares fn linear (fn(a·x + b·y) == a·fn(x) + b·fn(y), so
         #: fn(0) == 0). Enables the fused delta-vector fixpoint lowering
         #: for loop regions whose operator chain is linear end to end

@@ -37,18 +37,37 @@ __all__ = ["ShardedTpuExecutor"]
 class ShardedTpuExecutor(TpuExecutor):
     name = "sharded"
 
-    def __init__(self, mesh: Optional[Mesh] = None, *, fixpoint: bool = True):
+    def __init__(self, mesh: Optional[Mesh] = None, *, fixpoint: bool = True,
+                 model_axis: Optional[str] = None):
         super().__init__(fixpoint=fixpoint)
         self.mesh = mesh if mesh is not None else make_mesh()
+        #: tensor-parallel axis (VERDICT r4 #8): delta rows and keyed
+        #: state shard over the remaining (data) axes and REPLICATE over
+        #: this one; Map params with ``param_specs`` shard over it, and
+        #: the map fn runs its own model-axis collectives
+        #: (models.vit.vit_forward_tp). None = every mesh axis is data.
+        self.model_axis = model_axis
         names = self.mesh.axis_names
-        #: a 2-axis (dcn, ici) mesh shards over the flattened PRODUCT
-        #: axis (dcn-major — jax.lax.axis_index's flat order): key ranges
-        #: span all chips, intra-slice legs of the collectives ride ICI,
-        #: only the cross-slice legs cross DCN. Every collective this
-        #: executor emits accepts the tuple form.
+        if model_axis is not None:
+            if model_axis not in names:
+                raise GraphError(
+                    f"model_axis {model_axis!r} not in mesh axes {names}")
+            names = tuple(a for a in names if a != model_axis)
+            if not names:
+                raise GraphError("a pure-model mesh has no data axis; "
+                                 "add a delta axis")
+        #: a 2-axis (dcn, ici) data mesh shards over the flattened
+        #: PRODUCT axis (dcn-major — jax.lax.axis_index's flat order):
+        #: key ranges span all chips, intra-slice legs of the
+        #: collectives ride ICI, only the cross-slice legs cross DCN.
+        #: Every collective this executor emits accepts the tuple form.
         self.axis = names[0] if len(names) == 1 else tuple(names)
         import numpy as _np
         self.n = int(_np.prod([self.mesh.shape[a] for a in names]))
+        #: per-axis extents for 2-axis data meshes (the hierarchical
+        #: router needs static (n_dcn, n_ici)); None on 1-axis meshes
+        self._axis_sizes = (tuple(self.mesh.shape[a] for a in names)
+                            if len(names) > 1 else None)
         if self.n & (self.n - 1) or self.n > MIN_CAPACITY:
             raise GraphError(
                 f"mesh size {self.n} must be a power of two <= "
@@ -118,6 +137,16 @@ class ShardedTpuExecutor(TpuExecutor):
                 self.states[node.id]["rcount"] = jnp.zeros((n,), jnp.int32)
                 self.states[node.id]["gen"] = jnp.zeros((n,), jnp.int32)
                 self.states[node.id]["error"] = jnp.zeros((), jnp.bool_)
+                if "lkeys" in self.states[node.id]:
+                    La = node.op.left_arena_capacity or node.op.arena_capacity
+                    if La % n:
+                        raise GraphError(
+                            f"{node}: left_arena_capacity {La} must be a "
+                            f"multiple of the mesh size {n}")
+                    self.states[node.id]["lcount"] = jnp.zeros((n,),
+                                                               jnp.int32)
+                    self.states[node.id]["lgen"] = jnp.zeros((n,),
+                                                             jnp.int32)
         # placement derives from the SAME per-leaf specs shard_map uses
         # (one source of truth: _state_tree_specs), so the bound layout
         # can never disagree with the pass programs' in_specs
@@ -146,7 +175,17 @@ class ShardedTpuExecutor(TpuExecutor):
         knn_ids = getattr(self, "_knn_ids", frozenset())
         knn_axes = knn_state_specs(self.axis)
 
+        pspec_ids = {
+            node.id: node.op.param_specs for node in self.graph.nodes
+            if node.kind == "op" and node.op.kind == "map"
+            and node.op.param_specs is not None
+        } if getattr(self, "graph", None) is not None else {}
+
         def specs(nid, st):
+            if nid in pspec_ids:
+                # tensor-parallel Map: params shard per the op's declared
+                # specs (typically over the model axis)
+                return {"params": pspec_ids[nid]}
             if nid in repl:
                 return jax.tree.map(lambda _: P(), st)
             if nid in knn_ids:
@@ -158,7 +197,16 @@ class ShardedTpuExecutor(TpuExecutor):
 
     def update_params(self, node: Node, params) -> None:
         super().update_params(node, params)
-        self.states[node.id] = replicate(self.states[node.id], self.mesh)
+        if node.op.param_specs is not None:
+            from jax.sharding import NamedSharding
+
+            specs = self._state_tree_specs(
+                {node.id: self.states[node.id]})[node.id]
+            self.states[node.id] = jax.tree.map(
+                lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+                self.states[node.id], specs)
+        else:
+            self.states[node.id] = replicate(self.states[node.id], self.mesh)
 
     def refresh_minmax(self, node: Node, batch) -> None:
         """Sharded latch refresh: replay rows reach their key's owner
@@ -178,10 +226,13 @@ class ShardedTpuExecutor(TpuExecutor):
             oshape, odt = tuple(node.spec.value_shape), node.spec.value_dtype
             Kl = K // n
 
+            sizes = self._axis_sizes
+
             def body(st, dd):
                 import jax.numpy as jnp
                 base = (jax.lax.axis_index(axis) * Kl).astype(jnp.int32)
-                dl, route_err = deliver_to_owner(dd, axis, n, Kl)
+                dl, route_err = deliver_to_owner(dd, axis, n, Kl,
+                                                 sizes=sizes)
                 err = st["error"] | route_err
                 st2 = minmax_refresh_core(op, Kl, oshape, odt,
                                           {**st, "error": err}, dl,
@@ -203,7 +254,8 @@ class ShardedTpuExecutor(TpuExecutor):
     # -- the SPMD pass program ---------------------------------------------
 
     def _lower(self, node: Node, state, ins):
-        return lower_node_sharded(node, state, ins, self.axis, self.n)
+        return lower_node_sharded(node, state, ins, self.axis, self.n,
+                                  sizes=self._axis_sizes)
 
     def build_pass_fn(self, plan: List[Node], extra_egress=()):
         graph = self.graph

@@ -69,20 +69,98 @@ def _should_route(n: int, Cl: int) -> bool:
     return n > ROUTE_SLACK and ROUTE_SLACK * Cl >= _MIN_ROUTE_BUDGET * n
 
 
-def deliver_to_owner(d: DeviceDelta, axis: str, n: int, Kl: int
+def deliver_to_owner(d: DeviceDelta, axis, n: int, Kl: int,
+                     sizes: Optional[Tuple[int, ...]] = None
                      ) -> Tuple[DeviceDelta, jax.Array]:
     """Deliver every live row of a row-sharded delta to the shard owning
     its key range, returning a LOCAL-keyed delta plus the (pmax-combined)
     route-overflow flag. ONE definition of the routed-vs-replicated
     policy, shared by every keyed consumer (Reduce, Join, min/max, the
-    latch refresh) so no path can drift to a different policy."""
+    latch refresh) so no path can drift to a different policy.
+
+    On a 2-axis (dcn, ici) mesh (``axis`` a tuple, ``sizes`` its per-axis
+    extents) the routed path is HIERARCHICAL: an intra-slice ICI leg
+    delivers each row to its destination's ICI column, then ONE DCN
+    exchange crosses slices — each row crosses the slow network exactly
+    once, in per-slice aggregated messages, instead of the flat product
+    ``all_to_all`` treating every DCN link like an ICI link
+    (ROADMAP r4 #1 / VERDICT r4 #4)."""
     Cl = d.keys.shape[0]
     if _should_route(n, Cl):
-        dl, route_err = route_rows(d, axis, n, Kl)
+        if isinstance(axis, tuple) and sizes is not None:
+            dl, route_err = _route_rows_hier(d, axis, sizes, Kl)
+        else:
+            dl, route_err = route_rows(d, axis, n, Kl)
         return dl, jax.lax.pmax(route_err.astype(jnp.int32), axis) > 0
     base = (jax.lax.axis_index(axis) * Kl).astype(jnp.int32)
     g = jax.tree.map(lambda x: jax.lax.all_gather(x, axis, tiled=True), d)
     return _localize(g, base, Kl), jnp.zeros((), jnp.bool_)
+
+
+def _bucket_exchange(d: DeviceDelta, dest: jax.Array, n_sub: int, B: int,
+                     axis_name: str) -> Tuple[DeviceDelta, jax.Array]:
+    """One bucketed ``all_to_all`` leg: rows with ``dest`` in
+    ``[0, n_sub)`` pack into per-destination buckets of ``B`` slots
+    (``dest == n_sub`` drops — dead rows), exchange along ``axis_name``,
+    and return the received ``n_sub * B`` rows (keys untouched — global)
+    plus this shard's overflow flag."""
+    Cl = d.keys.shape[0]
+    order = jnp.argsort(dest, stable=True)
+    so = dest[order]
+    sk, sv, sw = d.keys[order], d.values[order], d.weights[order]
+    start = jnp.searchsorted(so, jnp.arange(n_sub, dtype=so.dtype))
+    slot = (jnp.arange(Cl, dtype=jnp.int32)
+            - start[jnp.minimum(so, n_sub - 1)])
+    ok = (so < n_sub) & (slot < B)
+    err = jnp.any((so < n_sub) & (slot >= B))
+    pos = jnp.where(ok, so.astype(jnp.int32) * B + slot, n_sub * B)
+    send_k = jnp.zeros((n_sub * B,), jnp.int32).at[pos].set(sk, mode="drop")
+    send_v = jnp.zeros((n_sub * B,) + d.values.shape[1:],
+                       d.values.dtype).at[pos].set(sv, mode="drop")
+    send_w = jnp.zeros((n_sub * B,), jnp.int32).at[pos].set(sw, mode="drop")
+
+    def xchg(a):
+        trail = a.shape[1:]
+        out = jax.lax.all_to_all(a.reshape((n_sub, B) + trail), axis_name,
+                                 0, 0)
+        return out.reshape((n_sub * B,) + trail)
+
+    return DeviceDelta(xchg(send_k), xchg(send_v), xchg(send_w)), err
+
+
+def _route_rows_hier(d: DeviceDelta, axes: Tuple[str, str],
+                     sizes: Tuple[int, int], Kl: int,
+                     slack: int = ROUTE_SLACK
+                     ) -> Tuple[DeviceDelta, jax.Array]:
+    """Two-stage owner delivery on a (dcn, ici) mesh: ICI leg to the
+    destination's ici column (intra-slice), then ONE DCN exchange to the
+    destination slice. Flat owner ids are dcn-major (the executor's
+    product-axis order), so ``owner = key // Kl``,
+    ``(own_dcn, own_ici) = divmod(owner, n_ici)``."""
+    dcn_ax, ici_ax = axes
+    n_dcn, n_ici = sizes
+    n = n_dcn * n_ici
+    Cl = d.keys.shape[0]
+    live = d.weights != 0
+    owner = jnp.where(live, jnp.clip(d.keys // Kl, 0, n - 1), n)
+    own_ici = jnp.where(owner < n, owner % n_ici, n_ici)
+    # stage 1 (ICI): to my slice's device in the destination's column
+    B1 = max(1, -(-slack * Cl // n_ici))
+    d1, err1 = _bucket_exchange(d, own_ici, n_ici, B1, ici_ax)
+    # stage 2 (DCN): to the destination slice (column now correct).
+    # Bucket size derives from the ORIGINAL live-row bound Cl, not the
+    # padded stage-1 capacity (which is already slack-inflated): the
+    # balanced per-device share after stage 1 is ~Cl rows split over
+    # n_dcn destinations, so slack*Cl/n_dcn gives the same skew headroom
+    # as the flat route at the same total capacity (~slack*Cl).
+    live1 = d1.weights != 0
+    owner1 = jnp.where(live1, jnp.clip(d1.keys // Kl, 0, n - 1), n)
+    own_dcn = jnp.where(owner1 < n, owner1 // n_ici, n_dcn)
+    B2 = max(1, -(-slack * Cl // n_dcn))
+    d2, err2 = _bucket_exchange(d1, own_dcn, n_dcn, B2, dcn_ax)
+    base = (jax.lax.axis_index(axes) * Kl).astype(jnp.int32)
+    lk = jnp.where(d2.weights != 0, d2.keys - base, 0)
+    return DeviceDelta(lk, d2.values, d2.weights), err1 | err2
 
 
 def route_rows(d: DeviceDelta, axis: str, n: int, Kl: int,
@@ -139,8 +217,8 @@ def _localize(d: DeviceDelta, base, Kl: int) -> DeviceDelta:
     )
 
 
-def _lower_reduce_sharded(op, node: Node, state, ins, axis: str, n: int
-                          ) -> Tuple[DeviceDelta, dict]:
+def _lower_reduce_sharded(op, node: Node, state, ins, axis, n: int,
+                          sizes=None) -> Tuple[DeviceDelta, dict]:
     (d,) = ins                      # local delta rows [Cl]
     in_spec = node.inputs[0].spec
     K = in_spec.key_space
@@ -155,8 +233,12 @@ def _lower_reduce_sharded(op, node: Node, state, ins, axis: str, n: int
 
     if ROUTE_SLACK * Cl < Kl:
         # sparse regime: route rows to their key's owner and fold locally
-        # — comms O(slack*Cl), independent of K
-        dl, route_err = route_rows(d, axis, n, Kl)
+        # — comms O(slack*Cl), independent of K (hierarchical two-stage
+        # on a 2-axis mesh: one DCN crossing per row)
+        if isinstance(axis, tuple) and sizes is not None:
+            dl, route_err = _route_rows_hier(d, axis, sizes, Kl)
+        else:
+            dl, route_err = route_rows(d, axis, n, Kl)
         dws, dwc = _scatter_contribs(dl, Kl)
         wsum = state["wsum"] + dws
         wcnt = state["wcnt"] + dwc
@@ -195,7 +277,7 @@ def _lower_reduce_sharded(op, node: Node, state, ins, axis: str, n: int
 
 
 def _lower_reduce_minmax_sharded(op, node: Node, state, ins,
-                                 axis: str, n: int
+                                 axis, n: int, sizes=None
                                  ) -> Tuple[DeviceDelta, dict]:
     """Retraction-capable min/max (scalar AND vector rows), key-sharded:
     delta rows reach their key's owner (routed ``all_to_all`` on large
@@ -209,7 +291,7 @@ def _lower_reduce_minmax_sharded(op, node: Node, state, ins,
     K = node.inputs[0].spec.key_space
     Kl = K // n
     base = (jax.lax.axis_index(axis) * Kl).astype(jnp.int32)
-    dl, route_err = deliver_to_owner(d, axis, n, Kl)
+    dl, route_err = deliver_to_owner(d, axis, n, Kl, sizes=sizes)
     err = state["error"] | route_err
 
     core_state = dict(state)
@@ -222,8 +304,8 @@ def _lower_reduce_minmax_sharded(op, node: Node, state, ins,
     return out, new_state
 
 
-def _lower_join_sharded(op, node: Node, state, ins, axis: str, n: int
-                        ) -> Tuple[DeviceDelta, dict]:
+def _lower_join_sharded(op, node: Node, state, ins, axis, n: int,
+                        sizes=None) -> Tuple[DeviceDelta, dict]:
     da, db = ins                    # local delta rows
     K = node.inputs[0].spec.key_space
     Kl = K // n
@@ -241,7 +323,7 @@ def _lower_join_sharded(op, node: Node, state, ins, axis: str, n: int
         nonlocal err
         if d is None:
             return None
-        dl, route_err = deliver_to_owner(d, axis, n, Kl)
+        dl, route_err = deliver_to_owner(d, axis, n, Kl, sizes=sizes)
         err = err | route_err
         return dl
 
@@ -253,10 +335,18 @@ def _lower_join_sharded(op, node: Node, state, ins, axis: str, n: int
     core_state = dict(state)
     core_state["rcount"] = state["rcount"][0]
     core_state["gen"] = state["gen"][0]
+    multiset = "lkeys" in state
+    if multiset:
+        core_state["lcount"] = state["lcount"][0]
+        core_state["lgen"] = state["lgen"][0]
     out, new_state = join_core(op, Kl, Rl, node.spec.value_dtype,
-                               core_state, da_l, db_l, key_offset=base)
+                               core_state, da_l, db_l, key_offset=base,
+                               oshape=tuple(node.spec.value_shape))
     new_state["rcount"] = new_state["rcount"][None]
     new_state["gen"] = new_state["gen"][None]
+    if multiset:
+        new_state["lcount"] = new_state["lcount"][None]
+        new_state["lgen"] = new_state["lgen"][None]
     # join_core's arena-overflow flag is per-shard; the state leaf is
     # replicated, so fold it with pmax before OR-ing the route error in
     new_state["error"] = err | (jax.lax.pmax(
@@ -276,7 +366,8 @@ def _lower_knn_sharded(op, node: Node, state, ins, axis: str, n: int
     partitioned by query range so the egress delta stays row-sharded.
     """
     from reflow_tpu.executors.lowerings import _fold_vectors, _norm_rows
-    from reflow_tpu.kernels.topk import NEG, chunked_corpus_topk, topk
+    from reflow_tpu.kernels.topk import (NEG, chunked_corpus_topk,
+                                         score_form, topk)
 
     dq, dd = ins
     if dq is None:
@@ -347,7 +438,7 @@ def _lower_knn_sharded(op, node: Node, state, ins, axis: str, n: int
         di = gd.keys
         own = (di >= base_d) & (di < base_d + Dl)
         di_l = jnp.where(own, di - base_d, 0)
-        s_loc = jnp.dot(qvec, dvec[di_l].T,
+        s_loc = jnp.dot(score_form(qvec), score_form(dvec[di_l]).T,
                         preferred_element_type=jnp.float32,
                         precision=prec)                        # [Q, Cd]
         s_loc = jnp.where((own & (gd.weights > 0))[None, :], s_loc, NEG)
@@ -392,15 +483,18 @@ def knn_state_specs(axis: str):
 
 
 def lower_node_sharded(node: Node, state, ins: Sequence[DeviceDelta],
-                       axis: str, n: int) -> Tuple[DeviceDelta, dict]:
+                       axis, n: int, sizes=None
+                       ) -> Tuple[DeviceDelta, dict]:
     kind = node.op.kind
     if kind == "reduce":
         if node.op.how in LINEAR_DEVICE_REDUCERS:
-            return _lower_reduce_sharded(node.op, node, state, ins, axis, n)
+            return _lower_reduce_sharded(node.op, node, state, ins, axis,
+                                         n, sizes=sizes)
         return _lower_reduce_minmax_sharded(node.op, node, state, ins,
-                                            axis, n)
+                                            axis, n, sizes=sizes)
     if kind == "join":
-        return _lower_join_sharded(node.op, node, state, ins, axis, n)
+        return _lower_join_sharded(node.op, node, state, ins, axis, n,
+                                   sizes=sizes)
     if kind == "knn":
         return _lower_knn_sharded(node.op, node, state, ins, axis, n)
     # stateless row ops are shard-local

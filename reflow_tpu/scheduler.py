@@ -140,6 +140,46 @@ class TickResult:
         return self
 
 
+class SourceCursor:
+    """Deterministic batch-id mint for exactly-once ingestion.
+
+    Under at-least-once upstream delivery, ``push(batch_id=...)`` dedups
+    replays. On MULTI-CONTROLLER runs the dedup sets must stay
+    SPMD-identical across processes (checkpoint meta assumes it —
+    verified collectively at save); deriving ids from a shared monotone
+    cursor makes that identity true BY CONSTRUCTION: every process mints
+    ``"<source>@<seq>"`` for the same global batch, regardless of which
+    local rows it contributes (``shard_batch_process_local``).
+
+    ``resume`` re-derives the cursor position after a checkpoint restore
+    from the restored dedup window, so a restarted driver neither reuses
+    an accepted id (its push would dedup away) nor skips one.
+    """
+
+    __slots__ = ("name", "seq")
+
+    def __init__(self, source: Node, start: int = 0):
+        self.name = source.name
+        self.seq = start
+
+    def next_id(self) -> str:
+        bid = f"{self.name}@{self.seq}"
+        self.seq += 1
+        return bid
+
+    @classmethod
+    def resume(cls, sched: "DirtyScheduler", source: Node) -> "SourceCursor":
+        prefix = source.name + "@"
+        top = -1
+        for bid in sched._seen_batch_ids:
+            if bid.startswith(prefix):
+                try:
+                    top = max(top, int(bid[len(prefix):]))
+                except ValueError:
+                    pass
+        return cls(source, top + 1)
+
+
 class DirtyScheduler:
     def __init__(self, graph: FlowGraph, executor: Optional[Executor] = None,
                  *, max_loop_iters: int = 10_000,
@@ -257,7 +297,14 @@ class DirtyScheduler:
 
         while ingress:
             if passes >= self.max_loop_iters:
+                # PAUSE, don't drop: the leftover loop deltas re-enter as
+                # pending for the next tick, so join/reduce state stays
+                # mutually consistent and a later tick (or a repair
+                # protocol like workloads/sssp.repair) resumes exactly
+                # where the halted iteration stopped
                 quiesced = False
+                for nid, batch in ingress.items():
+                    self._pending[nid].append(batch)
                 break
             plan = self._dirty_plan(list(ingress))
             dirty_union.update(n.id for n in plan)
@@ -268,12 +315,16 @@ class DirtyScheduler:
                     plan, ingress, self.max_loop_iters, sync=sync)
                 if fx is not None:
                     (sink_batches, fx_passes, loop_rows, quiesced,
-                     extra_dirty) = fx
+                     extra_dirty, leftover) = fx
                     passes = fx_passes
                     deltas_in = lazy_add(deltas_in, loop_rows)
                     dirty_union.update(extra_dirty)
                     for sid, batches in sink_batches.items():
                         sink_deltas[sink_ids[sid].name].extend(batches)
+                    # a max_iters halt pauses: live carry re-enters as
+                    # pending so the next tick resumes the iteration
+                    for nid, b in leftover.items():
+                        self._pending[nid].append(b)
                     break
             egress = self.executor.run_pass(plan, ingress)
             passes += 1
@@ -411,7 +462,34 @@ class DirtyScheduler:
         self.history.append(result)
         return result
 
-    def drain(self, source: Node, *, max_ticks: int = 256) -> int:
+    def rederive(self, source: Node, batch: DeltaBatch):
+        """Invalidate-and-re-derive (the ``refresh_minmax`` pattern
+        generalized to arbitrary derived state): retract ``batch``'s rows
+        at ``source`` and tick, then re-insert them and tick.
+
+        Because the retraction removes exactly the inputs that derived
+        the stale state, the affected keys' derived values vanish through
+        the normal exact algebra — retraction waves shrink monotonically
+        (no counting-to-infinity), so the retract tick quiesces even when
+        a normal incremental tick would not (e.g. an orphaned sustaining
+        cycle after an SSSP edge deletion — ``workloads/sssp.repair``).
+        The re-insertion then re-derives the keys from *current* upstream
+        values. A tick halted at ``max_loop_iters`` beforehand is fine:
+        its paused loop deltas resume inside the retract tick.
+
+        Returns the two synchronous TickResults (retract, re-insert).
+        """
+        if not len(batch):
+            raise GraphError("rederive needs a non-empty batch")
+        self.push(source, DeltaBatch(batch.keys, batch.values,
+                                     -np.asarray(batch.weights)))
+        r1 = self.tick()
+        self.push(source, batch)
+        r2 = self.tick()
+        return r1, r2
+
+    def drain(self, source: Node, *, max_ticks: int = 256,
+              probe_rows: int = 1) -> int:
         """Tick with empty (zero-weight probe) input at ``source`` until
         the graph quiesces. Flushes the residue a deferred fixpoint
         (``close_loop(defer_passes=...)``) carries across ticks: each
@@ -437,11 +515,16 @@ class DirtyScheduler:
                         f"drain({source.name}) does not reach deferred "
                         f"loop {l.name}'s region; probe a source feeding "
                         f"that region instead")
+        # probe_rows: all-zero-weight rows are semantic no-ops, so the
+        # count only picks the padded capacity BUCKET — pass the steady
+        # batch size to reuse an already-compiled program signature
+        # instead of compiling a fresh tiny-capacity one (~60s on the
+        # tunnel) just for the drain
         vshape = tuple(source.spec.value_shape)
         probe = DeltaBatch(
-            np.zeros(1, np.int64),
-            np.zeros((1,) + vshape, source.spec.value_dtype),
-            np.zeros(1, np.int64))
+            np.zeros(probe_rows, np.int64),
+            np.zeros((probe_rows,) + vshape, source.spec.value_dtype),
+            np.zeros(probe_rows, np.int64))
         for i in range(max_ticks):
             self.push(source, probe)
             r = self.tick(sync=False).block()

@@ -24,7 +24,7 @@ import os
 import pickle
 from typing import Dict
 
-__all__ = ["save_checkpoint", "load_checkpoint"]
+__all__ = ["save_checkpoint", "load_checkpoint", "meta_digest"]
 
 
 def _split_states(states: Dict[int, object]):
@@ -41,14 +41,42 @@ def _split_states(states: Dict[int, object]):
     return arr, host
 
 
+def meta_digest(tick: int, seen_batch_ids) -> int:
+    """64-bit digest of the host-side meta that multi-controller saves
+    assume SPMD-identical (tick counter + dedup window, in insertion
+    order — order divergence is divergence)."""
+    import hashlib
+
+    h = hashlib.sha256(repr((tick, list(seen_batch_ids))).encode())
+    return int.from_bytes(h.digest()[:8], "big")
+
+
 def save_checkpoint(sched, path: str) -> None:
     """Multi-controller: every process calls this collectively with the
     same (shared-filesystem) path — orbax writes each process's
     addressable shards of the global arrays; the host-side meta (tick
-    counter, sink views, dedup set — identical on every process by SPMD
-    construction) is written by process 0 alone."""
+    counter, sink views, dedup set) is written by process 0 alone.
+    That meta MUST be SPMD-identical across processes (use
+    ``scheduler.SourceCursor`` so batch ids are identical by
+    construction); rather than assume it, the save VERIFIES it with one
+    digest allgather and fails loudly on divergence — a process whose
+    dedup window drifted would otherwise silently restore the wrong
+    exactly-once horizon (VERDICT r4 #4a)."""
     import jax
 
+    if jax.process_count() > 1:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        mine = np.uint64(meta_digest(sched._tick, sched._seen_batch_ids))
+        digests = np.asarray(multihost_utils.process_allgather(mine))
+        if len(set(int(x) for x in digests.ravel())) != 1:
+            raise RuntimeError(
+                "checkpoint meta diverged across controllers (tick "
+                "counter or batch-id dedup window differs between "
+                "processes); mint batch ids from a shared "
+                "scheduler.SourceCursor so every process dedups "
+                "identically")
     os.makedirs(path, exist_ok=True)
     arr, host = _split_states(sched.executor.states)
     meta = {

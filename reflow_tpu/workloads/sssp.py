@@ -113,6 +113,83 @@ def seed_batch(node: int) -> DeltaBatch:
                       np.zeros(1, np.float32), np.ones(1, np.int64))
 
 
+def affected_set(n_nodes: int, src, dst, w, dist_prev: dict,
+                 del_src, del_dst, del_w) -> set:
+    """Conservative affected set for a batch of edge deletions
+    (Ramalingam–Reps phase 1, host-side, O(E)).
+
+    ``dist_prev`` is the TRUSTWORTHY pre-deletion distance table;
+    ``src/dst/w`` are the SURVIVING edges. A node is affected when its
+    (pre-deletion) shortest path may have used a deleted edge: seed with
+    each deleted edge's head whose distance was tight through it
+    (``dist[v] == dist[u] + w``), then close over the shortest-path DAG
+    of the surviving edges (descendants of a stale node are themselves
+    suspect). Conservative — a superset only costs re-derivation work,
+    never correctness.
+    """
+    inf = np.inf
+    d = np.full(n_nodes, inf)
+    for k, v in dist_prev.items():
+        d[int(k)] = v
+    def _tight(du, dv, ww):
+        # device distances are f32: tightness must tolerate one rounding
+        # (a false positive only widens the conservative superset)
+        return (np.isfinite(du) & np.isfinite(dv)
+                & np.isclose(dv, du + ww, rtol=1e-6, atol=1e-5))
+
+    seeds = set()
+    for u, v, ww in zip(np.asarray(del_src, np.int64),
+                        np.asarray(del_dst, np.int64),
+                        np.asarray(del_w, np.float64)):
+        if _tight(d[u], d[v], ww):
+            seeds.add(int(v))
+    if not seeds:
+        return set()
+    src = np.asarray(src, np.int64)
+    dst = np.asarray(dst, np.int64)
+    w = np.asarray(w, np.float64)
+    tight = _tight(d[src], d[dst], w)
+    affected = set(seeds)
+    frontier = list(seeds)
+    # adjacency over tight (shortest-path DAG) surviving edges only
+    from collections import defaultdict
+    adj = defaultdict(list)
+    for u, v in zip(src[tight], dst[tight]):
+        adj[int(u)].append(int(v))
+    while frontier:
+        u = frontier.pop()
+        for v in adj[u]:
+            if v not in affected:
+                affected.add(v)
+                frontier.append(v)
+    return affected
+
+
+def repair(sched, sg: SsspGraph, src, dst, w, affected: set):
+    """Ramalingam–Reps-style in-place repair after edge deletions
+    (module docstring: the orphaned-cycle case), WITHOUT a fresh
+    scheduler: ``sched.rederive`` the surviving in-edges of the affected
+    set. The retraction makes every affected candidate vanish through
+    the exact algebra (a shrinking wave — it quiesces even from a
+    paused, divergent iteration), and the re-insertion re-derives the
+    affected region from the valid boundary distances. Device work is
+    proportional to the affected region's in-edges + the relaxation
+    cascade — incremental, not a rebuild.
+
+    ``src/dst/w`` are the SURVIVING edges; returns the two TickResults.
+    """
+    src = np.asarray(src, np.int64)
+    dst = np.asarray(dst, np.int64)
+    mask = np.isin(dst, np.fromiter(affected, np.int64,
+                                    len(affected)))
+    if not mask.any():
+        raise ValueError("repair: affected set has no surviving in-edges "
+                         "(nothing to re-derive — the keys are simply "
+                         "unreachable; a normal tick settles that)")
+    batch = edge_batch(src[mask], dst[mask], np.asarray(w)[mask])
+    return sched.rederive(sg.edges, batch)
+
+
 def reference_distances(n_nodes, src_arr, dst_arr, w_arr, source: int):
     """Bellman-Ford oracle -> {node: distance} for reachable nodes."""
     dist = np.full(n_nodes, np.inf)

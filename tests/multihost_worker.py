@@ -87,6 +87,67 @@ def main() -> None:
     assert checked > 0
     print(f"proc {pid}: verified {checked} owned ranks OK", flush=True)
 
+    # -- round 5: cross-controller exactly-once + collective ckpt ---------
+    # batch ids minted from the shared cursor are SPMD-identical by
+    # construction; a redelivered (duplicate) push must dedup on BOTH
+    # processes, keeping the dedup windows digest-equal
+    import tempfile
+
+    from reflow_tpu.scheduler import SourceCursor
+    from reflow_tpu.utils.checkpoint import (load_checkpoint, meta_digest,
+                                             save_checkpoint)
+
+    cur = SourceCursor(pr.edges)
+    churn2 = web.churn(0.02)
+    bid = cur.next_id()
+    acc1 = sched.push(pr.edges, shard_batch_process_local(
+        split(churn2), pr.edges.spec, mesh, capacity=1 << 9),
+        batch_id=bid)
+    # redelivery replay: same id -> dropped, no tick content
+    acc2 = sched.push(pr.edges, shard_batch_process_local(
+        split(churn2), pr.edges.spec, mesh, capacity=1 << 9),
+        batch_id=bid)
+    assert acc1 and not acc2, (acc1, acc2)
+    r3 = sched.tick(sync=False)
+    r3.block()
+    assert r3.quiesced
+
+    # digest agreement (what save_checkpoint verifies collectively)
+    from jax.experimental import multihost_utils
+    mine = np.uint64(meta_digest(sched._tick, sched._seen_batch_ids))
+    digs = np.asarray(multihost_utils.process_allgather(mine))
+    assert len({int(x) for x in digs.ravel()}) == 1, digs
+
+    # collective checkpoint -> restore into a FRESH scheduler -> both
+    # continue with one more churn tick -> owned shards must agree
+    ckpt_dir = os.environ.get("REFLOW_MH_CKPT")
+    assert ckpt_dir, "driver must pass a shared ckpt dir"
+    save_checkpoint(sched, ckpt_dir)
+
+    pr2 = pagerank.build_graph(N_NODES, tol=5e-5, arena_capacity=1 << 16)
+    ex2 = ShardedTpuExecutor(make_mesh(dcn=nproc))
+    sched2 = DirtyScheduler(pr2.graph, ex2, max_loop_iters=500)
+    load_checkpoint(sched2, ckpt_dir)
+    cur2 = SourceCursor.resume(sched2, pr2.edges)
+    assert cur2.seq == cur.seq, (cur2.seq, cur.seq)
+
+    churn3 = web.churn(0.02)
+    for s, prx, c in ((sched, pr, cur), (sched2, pr2, cur2)):
+        s.push(prx.edges, shard_batch_process_local(
+            split(churn3), prx.edges.spec, s.executor.mesh,
+            capacity=1 << 9), batch_id=c.next_id())
+        rr = s.tick(sync=False)
+        rr.block()
+        assert rr.quiesced
+
+    em_a = ex.states[pr.new_rank.id]["emitted"]
+    em_b = ex2.states[pr2.new_rank.id]["emitted"]
+    for sa, sb in zip(em_a.addressable_shards, em_b.addressable_shards):
+        np.testing.assert_allclose(np.asarray(sa.data),
+                                   np.asarray(sb.data), atol=1e-5)
+    print(f"proc {pid}: exactly-once + ckpt/restore continuation OK",
+          flush=True)
+
 
 if __name__ == "__main__":
     main()

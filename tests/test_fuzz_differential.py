@@ -80,16 +80,37 @@ def build_random_graph(rng: np.random.Generator):
             a, b = rng.choice(streams), rng.choice(streams)
             streams.append(g.union(a, b))
         elif kind == "join":
-            if not uniques:
-                continue
-            left = rng.choice(uniques)
-            right = rng.choice(streams)
-            w = int(rng.integers(1, 3))
-            node = g.join(
-                left, right,
-                merge=lambda k, va, vb, w=w: va + np.float32(w) * vb,
-                arena_capacity=1 << 12)
-            streams.append(node)
+            if uniques and rng.random() < 0.6:
+                left = rng.choice(uniques)
+                right = rng.choice(streams)
+                w = int(rng.integers(1, 3))
+                node = g.join(
+                    left, right,
+                    merge=lambda k, va, vb, w=w: va + np.float32(w) * vb,
+                    arena_capacity=1 << 12)
+                streams.append(node)
+            else:
+                # MULTISET-left join with the DEFAULT merge (VERDICT r4
+                # #5): both sides are plain delta streams; the device
+                # path runs the two-arena pair-enumeration kernel, the
+                # default merge emits the flattened (va, vb) pair. A
+                # projection Map + Reduce fold the pair stream back to a
+                # compact unique stream — observing every product row in
+                # the sums while keeping the (deliberately conservative)
+                # static egress-capacity estimate of the pair stream out
+                # of downstream Join arena checks.
+                left = rng.choice(streams)
+                right = rng.choice(streams)
+                pair = g.join(
+                    left, right,
+                    spec=Spec((2,), np.float32, key_space=K),
+                    arena_capacity=1 << 12, product_slack=16)
+                proj = g.map(pair, lambda v: v[:, 0] + np.float32(2) * v[:, 1],
+                             vectorized=True,
+                             spec=Spec((), np.float32, key_space=K))
+                node = g.reduce(proj, "sum", tol=1e-6)
+                uniques.append(node)
+                streams.append(node)
     sink = g.sink(streams[-1], "out")
 
     # stage assignment for the staged executor: two contiguous stages

@@ -38,6 +38,10 @@ def test_two_process_dcn_mesh_tick():
     env["XLA_FLAGS"] = " ".join(flags)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
 
+    import tempfile
+    ckpt_dir = tempfile.mkdtemp(prefix="reflow_mh_ckpt_")
+    env["REFLOW_MH_CKPT"] = ckpt_dir
+
     worker = os.path.join(_REPO, "tests", "multihost_worker.py")
     procs = [subprocess.Popen(
         [sys.executable, worker, coord, str(i), "2"],
@@ -68,3 +72,6 @@ def test_two_process_dcn_mesh_tick():
                 "tests/multihost_worker.py 127.0.0.1:12345 $i 2 & done")
         pytest.fail(f"multihost worker failed:\n{joined[-4000:]}")
     assert "proc 0: verified" in joined and "proc 1: verified" in joined
+    assert ("proc 0: exactly-once + ckpt/restore continuation OK" in joined
+            and "proc 1: exactly-once + ckpt/restore continuation OK"
+            in joined)

@@ -1,0 +1,153 @@
+"""Multiset-left device Join (VERDICT r4 #5 / ROADMAP r4 #2).
+
+The device path holds BOTH join sides as append arenas and runs each
+δ-product as a key-matched pair enumeration at a static budget
+(``product_slack x delta_capacity`` slots). These tests pin the
+semantics the fuzz can't target precisely: default-merge encoding,
+vector values, budget overflow -> sticky error (never truncation), and
+the bind-time spec validation. Differential coverage against the host
+oracle also runs inside tests/test_fuzz_differential.py's grammar
+(multiset-left joins are drawn there with default merge).
+"""
+
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from reflow_tpu import DirtyScheduler, FlowGraph
+from reflow_tpu.delta import DeltaBatch, Spec
+from reflow_tpu.executors import get_executor
+from reflow_tpu.graph import GraphError
+from reflow_tpu.parallel import make_mesh
+from reflow_tpu.parallel.shard import ShardedTpuExecutor
+
+K = 16
+
+
+def _flat(v):
+    if isinstance(v, tuple):
+        out = []
+        for x in v:
+            out.extend(_flat(x) if isinstance(x, tuple) else [float(x)])
+        return tuple(round(x, 3) for x in out)
+    return tuple(round(float(x), 3) for x in np.asarray(v).ravel())
+
+
+def _view(sched, sink):
+    return Counter({(int(k), _flat(v)): w
+                    for (k, v), w in sched.view(sink).items() if w})
+
+
+def build_default(arena=2048, slack=4):
+    g = FlowGraph("msj")
+    a = g.source("a", Spec((), np.float32, key_space=K))
+    b = g.source("b", Spec((), np.float32, key_space=K))
+    j = g.join(a, b, spec=Spec((2,), np.float32, key_space=K),
+               arena_capacity=arena, product_slack=slack)
+    g.sink(j, "out")
+    return g, a, b
+
+
+def batch(keys, vals, w):
+    return DeltaBatch(np.asarray(keys, np.int64),
+                      np.asarray(vals, np.float32),
+                      np.asarray(w, np.int64))
+
+
+EXECUTORS = {
+    "cpu": lambda: get_executor("cpu"),
+    "tpu": lambda: get_executor("tpu"),
+    "sharded": lambda: ShardedTpuExecutor(make_mesh(8)),
+}
+
+
+def drive_default(name):
+    g, a, b = build_default()
+    sched = DirtyScheduler(g, EXECUTORS[name]())
+    # tick 1: multiset left (repeated key 3, weight-2 row), right rows
+    sched.push(a, batch([3, 3, 5], [1., 2., 7.], [1, 2, 1]))
+    sched.push(b, batch([3, 5, 5], [10., 20., 30.], [1, 1, 1]))
+    sched.tick()
+    # tick 2: left retraction + insert, another right row
+    sched.push(a, batch([3, 5], [1., 9.], [-1, 1]))
+    sched.push(b, batch([3], [40.], [1]))
+    sched.tick()
+    # tick 3: right retraction (pairs with ALL left rows of that key)
+    sched.push(b, batch([5], [20.], [-1]))
+    sched.tick()
+    return _view(sched, "out")
+
+
+def test_default_merge_differential_all_executors():
+    ref = drive_default("cpu")
+    assert ref  # non-trivial
+    for name in ("tpu", "sharded"):
+        got = drive_default(name)
+        assert got == ref, (f"{name} disagrees: only-{name} {got - ref}, "
+                            f"only-cpu {ref - got}")
+
+
+def drive_custom(name):
+    g = FlowGraph("msjc")
+    a = g.source("a", Spec((2,), np.float32, key_space=K))
+    b = g.source("b", Spec((), np.float32, key_space=K))
+
+    def merge(k, va, vb):
+        if getattr(va, "ndim", 1) <= 1:       # host per-row form
+            return np.float64(va[0]) * vb + va[1]
+        import jax.numpy as jnp
+        return va[:, 0] * vb + va[:, 1]
+
+    j = g.join(a, b, merge=merge, spec=Spec((), np.float32, key_space=K),
+               arena_capacity=2048)
+    g.sink(j, "out")
+    sched = DirtyScheduler(g, EXECUTORS[name]())
+    sched.push(a, batch([2, 2], [[2., 1.], [3., 0.]], [1, 1]))
+    sched.push(b, batch([2, 2], [5., 6.], [1, 2]))
+    sched.tick()
+    sched.push(a, batch([2], [[2., 1.]], [-1]))
+    sched.tick()
+    return _view(sched, "out")
+
+
+def test_custom_merge_vector_left_differential():
+    ref = drive_custom("cpu")
+    assert ref
+    for name in ("tpu", "sharded"):
+        assert drive_custom(name) == ref, name
+
+
+def test_product_budget_overflow_sticky_error():
+    """A true pair count beyond product_slack x delta_capacity must fail
+    LOUDLY at the next sync — never silently truncate."""
+    g, a, b = build_default(slack=1)
+    sched = DirtyScheduler(g, get_executor("tpu"))
+    # 60 left rows on ONE key, then 60 right rows on that key: the δB
+    # product wants 60*60 = 3600 pairs against budget 1*64 = 64
+    sched.push(a, batch(np.full(60, 3), np.arange(60), np.ones(60)))
+    sched.tick()
+    sched.push(b, batch(np.full(60, 3), np.arange(60), np.ones(60)))
+    with pytest.raises(RuntimeError, match="sticky"):
+        sched.tick()
+
+
+def test_default_merge_spec_shape_validated_at_bind():
+    g = FlowGraph("msv")
+    a = g.source("a", Spec((), np.float32, key_space=K))
+    b = g.source("b", Spec((), np.float32, key_space=K))
+    g.join(a, b, arena_capacity=2048)   # default out spec: scalar (wrong)
+    g.sink(g.nodes[-1], "out")
+    with pytest.raises(GraphError, match="flat value elements"):
+        DirtyScheduler(g, get_executor("tpu"))
+
+
+def test_read_table_rejects_multiset_join():
+    g, a, b = build_default()
+    sched = DirtyScheduler(g, get_executor("tpu"))
+    sched.push(a, batch([1], [1.], [1]))
+    sched.tick()
+    join_node = next(n for n in g.nodes
+                     if n.kind == "op" and n.op.kind == "join")
+    with pytest.raises(KeyError, match="multiset"):
+        sched.read_table(join_node)

@@ -170,19 +170,28 @@ def test_tpu_accepts_minmax_reducer():
     assert sched.view_dict("out") == {1: 1.0, 2: 2.0}
 
 
-def test_tpu_join_requires_unique_left():
+def test_tpu_join_nonunique_left_takes_multiset_path():
+    """Round 5: a non-unique left is no longer a bind error — it lowers
+    to the two-arena multiset path (state carries the left arena, not a
+    dense left table). Semantics covered by tests/test_multiset_join.py
+    and the fuzz grammar."""
     spec = Spec((), np.float32, key_space=8)
     g = FlowGraph()
     a = g.source("a", spec)
     b = g.source("b", spec)
-    g.sink(g.join(a, b, merge=lambda k, x, y: x + y, spec=spec), "out")
-    with pytest.raises(GraphError, match="unique-keyed"):
-        DirtyScheduler(g, get_executor("tpu"))
+    j = g.join(a, b, merge=lambda k, x, y: x + y, spec=spec,
+               arena_capacity=256)
+    g.sink(j, "out")
+    ex = get_executor("tpu")
+    DirtyScheduler(g, ex)
+    assert "lkeys" in ex.states[j.id]          # multiset-left arena
+    assert "lval" not in ex.states[j.id]       # no dense unique table
 
 
 def test_groupby_clears_unique_flag():
-    """Regression: re-keying can collapse keys, so the device Join's
-    unique-left check must reject a GroupBy output."""
+    """Regression: re-keying can collapse keys, so a GroupBy output must
+    lose Spec.unique — the device Join then takes the multiset-left
+    path (it would silently under-join on the dense unique table)."""
     spec = Spec((), np.float32, key_space=8)
     g = FlowGraph()
     a = g.source("a", spec)
@@ -190,9 +199,12 @@ def test_groupby_clears_unique_flag():
     u = g.reduce(a, "sum")          # unique=True here
     grouped = g.group_by(u, lambda k, v: k // 2, vectorized=True)
     assert not grouped.spec.unique
-    g.sink(g.join(grouped, b, merge=lambda k, x, y: x + y, spec=spec), "out")
-    with pytest.raises(GraphError, match="unique-keyed"):
-        DirtyScheduler(g, get_executor("tpu"))
+    j = g.join(grouped, b, merge=lambda k, x, y: x + y, spec=spec,
+               arena_capacity=256)
+    g.sink(j, "out")
+    ex = get_executor("tpu")
+    DirtyScheduler(g, ex)
+    assert "lkeys" in ex.states[j.id]
 
 
 def test_rebind_clears_compiled_cache():
