@@ -594,3 +594,66 @@ def test_streaming_ticks_do_not_force_sync():
     assert not r.forced_sync and sched.forced_syncs == 0
     sched.read_table(pg.new_rank)     # explicit sync point
     assert sched.forced_syncs == 1
+
+
+def test_source_cursor_mint_and_resume():
+    """SourceCursor mints deterministic '<source>@<seq>' ids (the
+    SPMD-identical exactly-once scheme) and resume() re-derives the
+    position from a restored dedup window, skipping foreign ids."""
+    import numpy as np
+
+    from reflow_tpu.delta import DeltaBatch, Spec
+    from reflow_tpu.graph import FlowGraph
+    from reflow_tpu.scheduler import DirtyScheduler, SourceCursor
+
+    g = FlowGraph("cur")
+    src = g.source("s", Spec((), np.float32, key_space=8))
+    g.sink(g.reduce(src, "sum"), "out")
+    sched = DirtyScheduler(g)
+    cur = SourceCursor(src)
+    b = DeltaBatch(np.array([1]), np.array([1.0], np.float32),
+                   np.ones(1, np.int64))
+    ids = [cur.next_id() for _ in range(3)]
+    assert ids == ["s@0", "s@1", "s@2"]
+    for bid in ids:
+        assert sched.push(src, b, batch_id=bid)
+    assert not sched.push(src, b, batch_id="s@1")   # replay dedups
+    sched._seen_batch_ids["other@99"] = None        # foreign id ignored
+    sched._seen_batch_ids["s@junk"] = None          # malformed ignored
+    cur2 = SourceCursor.resume(sched, src)
+    assert cur2.seq == 3
+    assert cur2.next_id() == "s@3"
+
+
+def test_checkpoint_meta_digest_order_sensitive():
+    """The multi-controller save guard digests the dedup window IN
+    ORDER: two processes that accepted the same ids in different orders
+    have genuinely diverged (their eviction horizons differ)."""
+    from reflow_tpu.utils.checkpoint import meta_digest
+
+    a = meta_digest(5, ["s@0", "s@1"])
+    b = meta_digest(5, ["s@1", "s@0"])
+    c = meta_digest(6, ["s@0", "s@1"])
+    assert a != b and a != c
+    assert a == meta_digest(5, ["s@0", "s@1"])
+
+
+def test_drain_rejects_unreachable_source():
+    """drain() must refuse a probe source that cannot structurally reach
+    a deferred loop's region (its ticks would report quiescence without
+    running the region's program on fallback executors)."""
+    import numpy as np
+    import pytest
+
+    from reflow_tpu.delta import Spec
+    from reflow_tpu.graph import FlowGraph, GraphError
+    from reflow_tpu.scheduler import DirtyScheduler
+    from reflow_tpu.workloads import pagerank
+
+    pg = pagerank.build_graph(32, defer_passes=2, arena_capacity=1024)
+    # an unrelated source grafted onto the same graph, pre-validation
+    other = pg.graph.source("unrelated", Spec((), np.float32, key_space=8))
+    pg.graph.sink(pg.graph.reduce(other, "sum"), "o")
+    sched = DirtyScheduler(pg.graph)
+    with pytest.raises(GraphError, match="does not reach"):
+        sched.drain(other)

@@ -170,3 +170,23 @@ def test_tensor_parallel_vit_matches_oracle(params):
                                   if k != "_cfg"})
     wq2 = ex.states[embed_node.id]["params"]["blocks"][0]["wq"]
     assert wq2.addressable_shards[0].data.shape == (dim, dim // 4)
+
+
+def test_tensor_parallel_rejects_nondivisible_heads():
+    """A model axis that doesn't divide the head count must fail LOUDLY
+    at trace time — heads=4 over m=8 would otherwise silently fuse
+    fractional heads (every pure-shape check passes)."""
+    import pytest
+
+    from reflow_tpu.parallel.mesh import make_model_mesh
+
+    mesh = make_model_mesh(1, 8)          # m=8; VIT_TINY heads=4
+    ex = ShardedTpuExecutor(mesh, model_axis="model")
+    p = init_vit(0, **VIT_TINY)
+    p["_cfg"] = VIT_TINY
+    ig = image_embed.build_graph(N_IMG, N_GRP, p, model_axis="model")
+    sched = DirtyScheduler(ig.graph, ex)
+    stream = image_embed.ImageStream(p, seed=4)
+    sched.push(ig.images, stream.insert(np.arange(8), np.zeros(8, int)))
+    with pytest.raises(ValueError, match="must divide heads"):
+        sched.tick()
