@@ -104,8 +104,9 @@ def _params():
         "tol": 1e-4,
         # cross-tick residual deferral (close_loop defer_passes) for the
         # pr_tpu_defer child — the incr_vs_full lever (VERDICT r4 #1);
-        # accuracy verified in-record against reference_ranks. Unset /
-        # empty / <= 0 all mean "no deferred child".
+        # accuracy verified in-record against reference_ranks. Unset
+        # defaults to defer=1 (the measured-dominant mode); set to 0,
+        # empty, or a non-integer to skip the deferred child.
         "defer": _defer_env(),
     }
 
@@ -537,8 +538,14 @@ def main() -> None:
             "deferred_tick_s_amortized": tpud.get("tick_s_amortized"),
             "deferred_mid_stream_max_abs_err":
                 tpud.get("mid_stream_max_abs_err"),
+            "deferred_mid_stream_max_rel_err":
+                tpud.get("mid_stream_max_rel_err"),
             "deferred_drained_max_abs_err":
-                tpud.get("drained_max_abs_err")} if tpud else {}),
+                tpud.get("drained_max_abs_err"),
+            "deferred_drained_max_rel_err":
+                tpud.get("drained_max_rel_err"),
+            "quiescent_max_rel_err":
+                tpu.get("max_rel_err_vs_reference")} if tpud else {}),
     }))
 
 

@@ -211,8 +211,15 @@ def test_random_linear_loop_all_programs_agree(seed):
     ref = as_vec(tables["cpu"])
     for name in ("tpu_linear", "tpu_row", "sharded", "tpu_defer1",
                  "sharded_defer2"):
+        # tol-gated emission lag amplifies through the contraction like
+        # tol/(1-c) — proportional to the key's VALUE — so the bound is
+        # assert_allclose's additive atol + rtol*|ref|: a 1e-3 absolute
+        # floor (10x the grammar's tol=1e-4, TIGHTER than the old pure
+        # 2e-3 atol for small keys) plus a 5e-4 relative allowance for
+        # large keys (an extended-seed sweep found a value-4.5 key at
+        # abs 2.1e-3 / rel 1.8e-4: pure tol-lag, not divergence)
         np.testing.assert_allclose(
-            as_vec(tables[name]), ref, atol=2e-3,
+            as_vec(tables[name]), ref, rtol=5e-4, atol=1e-3,
             err_msg=f"seed {seed}: {name} diverges "
                     f"(groupby={use_groupby}, maps={map_cs})")
 
