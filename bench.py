@@ -48,6 +48,10 @@ Env knobs::
     REFLOW_BENCH_CPU_FULL=1       CPU at full scale (overrides cap; slow)
     REFLOW_BENCH_ALL=0            skip configs 1/2/4/5 (default: run them)
     REFLOW_BENCH_TRACE=<dir>      xprof device trace of one churn tick
+    REFLOW_BENCH_RECOVERY=1       WAL mode instead: ingestion overhead per
+                                  fsync policy + time-to-first-tick after a
+                                  simulated crash (CPU-only, no tunnel)
+    REFLOW_BENCH_RECOVERY_TICKS   crash-backlog size  (default 1000)
 """
 
 from __future__ import annotations
@@ -121,6 +125,100 @@ def _defer_env():
     except ValueError:
         return None
     return v if v > 0 else None
+
+
+# -- WAL / crash-recovery mode (REFLOW_BENCH_RECOVERY=1) -------------------
+
+def run_recovery_bench() -> dict:
+    """Durable-ingestion numbers (docs/guide.md "Write-ahead delta log"):
+
+    1. WAL append overhead: the same wordcount drive with no WAL vs each
+       fsync policy (``os`` / ``tick`` / ``record``) — the per-tick
+       policy is the default, so its overhead is the headline cost of
+       durability.
+    2. Recovery: abandon the per-tick run mid-flight with the full
+       backlog in the log (the simulated kill, final record torn), then
+       time ``recover()`` + the first post-recovery tick on a fresh
+       scheduler — time-to-first-tick after a crash at N ticks of
+       backlog.
+
+    Host-side end to end (the WAL is host-boundary machinery); runs on
+    the CPU executor so no tunnel protocol applies."""
+    import shutil
+    import tempfile
+
+    from reflow_tpu.scheduler import DirtyScheduler
+    from reflow_tpu.utils.faults import tear_wal_tail
+    from reflow_tpu.utils.metrics import summarize_wal
+    from reflow_tpu.wal import DurableScheduler, recover
+    from reflow_tpu.workloads import wordcount
+
+    backlog = int(os.environ.get("REFLOW_BENCH_RECOVERY_TICKS", "1000"))
+    rows_per_tick = 8
+
+    def drive(sched, src):
+        rng = np.random.default_rng(11)
+        t0 = time.perf_counter()
+        for t in range(backlog):
+            words = " ".join(f"w{int(x)}"
+                             for x in rng.integers(0, 1000, rows_per_tick))
+            sched.push(src, wordcount.ingest_lines([words]),
+                       batch_id=f"t{t}")
+            sched.tick()
+        return time.perf_counter() - t0
+
+    out = {"backlog_ticks": backlog, "rows_per_tick": rows_per_tick}
+    g, src, _sink = wordcount.build_graph()
+    base_s = drive(DirtyScheduler(g), src)
+    out["no_wal_s"] = round(base_s, 3)
+    tmp = tempfile.mkdtemp(prefix="reflow_wal_bench_")
+    try:
+        crash_dir = None
+        for policy in ("os", "tick", "record"):
+            wal_dir = os.path.join(tmp, policy)
+            g, src, _sink = wordcount.build_graph()
+            sched = DurableScheduler(g, wal_dir=wal_dir, fsync=policy)
+            wall = drive(sched, src)
+            wm = summarize_wal(sched.wal)
+            out[f"wal_{policy}_s"] = round(wall, 3)
+            out[f"wal_{policy}_overhead_x"] = round(wall / base_s, 3)
+            out[f"wal_{policy}_append_p50_us"] = round(
+                wm.append_p50_s * 1e6, 1)
+            out[f"wal_{policy}_fsync_p50_us"] = round(
+                wm.fsync_p50_s * 1e6, 1)
+            log(f"wal[{policy}]: {wall:.3f}s "
+                f"({out[f'wal_{policy}_overhead_x']}x of no-WAL "
+                f"{base_s:.3f}s; append p50 "
+                f"{out[f'wal_{policy}_append_p50_us']}us)")
+            if policy == "tick":
+                crash_dir = wal_dir  # the default policy's log is the
+                # crash corpus; the writer is simply abandoned (killed)
+        tear_wal_tail(crash_dir, 7)   # the kill also tore a record
+        g, src, _sink = wordcount.build_graph()
+        fresh = DirtyScheduler(g)
+        t0 = time.perf_counter()
+        report = recover(fresh, crash_dir)
+        recover_s = time.perf_counter() - t0
+        words = " ".join(f"w{i}" for i in range(rows_per_tick))
+        fresh.push(src, wordcount.ingest_lines([words]),
+                   batch_id="post-crash")
+        t1 = time.perf_counter()
+        fresh.tick()
+        first_tick_s = time.perf_counter() - t1
+        out.update({
+            "recover_s": round(recover_s, 3),
+            "recovered_ticks_per_s": round(report.replayed_ticks
+                                           / max(recover_s, 1e-9)),
+            "replayed_pushes": report.replayed_pushes,
+            "replayed_ticks": report.replayed_ticks,
+            "torn_tail_tolerated": report.torn_tail is not None,
+            "first_tick_s": round(first_tick_s, 4),
+            "time_to_first_tick_s": round(recover_s + first_tick_s, 3),
+        })
+        log("recovery:", json.dumps(report.as_dict()))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
 
 
 # -- config 3 measurements -------------------------------------------------
@@ -423,6 +521,18 @@ def _spawn(name: str) -> dict:
 
 
 def main() -> None:
+    if os.environ.get("REFLOW_BENCH_RECOVERY") == "1":
+        # WAL mode is host-side CPU work — no tunnel, no subprocesses
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        out = run_recovery_bench()
+        print(json.dumps({
+            "metric": "wal_recovery_time_to_first_tick_s",
+            "value": out["time_to_first_tick_s"],
+            "unit": "s",
+            **out,
+        }))
+        return
+
     child = os.environ.get("REFLOW_BENCH_CHILD")
     if child:
         try:

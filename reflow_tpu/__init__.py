@@ -26,6 +26,7 @@ from reflow_tpu.graph import FlowGraph
 from reflow_tpu.scheduler import DirtyScheduler
 from reflow_tpu.executors import CpuExecutor, Executor, get_executor
 from reflow_tpu.utils.config import ReflowConfig
+from reflow_tpu.wal import DurableScheduler, recover
 
 __version__ = "0.1.0"
 
@@ -34,9 +35,11 @@ __all__ = [
     "Spec",
     "FlowGraph",
     "DirtyScheduler",
+    "DurableScheduler",
     "Executor",
     "CpuExecutor",
     "get_executor",
+    "recover",
     "ReflowConfig",
     "__version__",
 ]

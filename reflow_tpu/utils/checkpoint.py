@@ -1,9 +1,15 @@
 """Durable checkpoint/resume (SURVEY.md §5).
 
 The durable state of an incremental dataflow is small and well-defined:
-(per-node operator state, tick counter, materialized sink views). Sources
-are the user's responsibility to replay from their own cursor — the
-checkpoint records ``tick`` so the host driver knows where its cursor was.
+(per-node operator state, tick counter, materialized sink views). The
+checkpoint records ``tick`` so the host driver knows where its cursor
+was. On its own, a checkpoint covers ingestion only *at* save points —
+everything pushed since the last save is lost on a crash unless the
+upstream replays it. ``reflow_tpu.wal`` closes that window: a WAL-backed
+scheduler (``wal.DurableScheduler``) logs every accepted batch, the save
+records the log replay position (``"wal_pos"``) and truncates the sealed
+segments it covers, and ``wal.recovery.recover`` restores checkpoint +
+tail for exactly-once ingestion across process death.
 
 Two serialization paths behind one API:
 
@@ -91,6 +97,19 @@ def save_checkpoint(sched, path: str) -> None:
         "host_states": pickle.dumps(host),
         "has_array_states": bool(arr),
     }
+    # a WAL-backed scheduler (wal/durable.py): everything the log holds
+    # up to now is covered by this checkpoint. Rotate so the whole
+    # covered history sits in sealed segments, record the fresh
+    # segment's start as the replay position, and drop the sealed
+    # segments once the save has fully landed (never before — a failed
+    # save must leave the tail replayable).
+    wal = getattr(sched, "wal", None)
+    if wal is not None:
+        wal.sync()
+        wal.rotate()
+        meta["wal_pos"] = tuple(wal.position())
+        wal.append({"kind": "ckpt", "tick": sched._tick,
+                    "path": os.path.abspath(path)})
     if jax.process_index() == 0:
         with open(os.path.join(path, "meta.pkl"), "wb") as f:
             pickle.dump(meta, f)
@@ -101,10 +120,16 @@ def save_checkpoint(sched, path: str) -> None:
         ckpt.save(os.path.join(os.path.abspath(path), "states"), arr,
                   force=True)
         ckpt.wait_until_finished()
+    if wal is not None:
+        from reflow_tpu.wal.log import LogPosition
+
+        wal.truncate_until(LogPosition(*meta["wal_pos"]))
 
 
-def load_checkpoint(sched, path: str) -> None:
-    """Restore into a scheduler whose graph/executor match the saved one."""
+def load_checkpoint(sched, path: str) -> Dict:
+    """Restore into a scheduler whose graph/executor match the saved one.
+    Returns the checkpoint meta dict (``wal.recovery.recover`` reads the
+    recorded WAL replay position, ``"wal_pos"``, from it)."""
     from collections import Counter
 
     with open(os.path.join(path, "meta.pkl"), "rb") as f:
@@ -140,3 +165,4 @@ def load_checkpoint(sched, path: str) -> None:
     # lineages can share a (gen, rcount) pair over different arena rows,
     # so the in-program validity predicate alone cannot see the swap.
     sched.executor.on_states_replaced()
+    return meta

@@ -10,10 +10,20 @@ transport's retransmission makes the composition exactly-once: after
 ``flush()`` every batch has been folded into the graph exactly once, so a
 faulty run's sink views must equal a clean run's — the property the
 fault-injection tests assert.
+
+Beyond the lossy transport, this module injects **process death**:
+:class:`CrashInjector` raises :class:`CrashPoint` at the WAL's
+instrumented seams (before/after the append, between push and tick, at
+the tick marker — ``wal/durable.py``), and :func:`tear_wal_tail`
+truncates the log mid-record after the fact, simulating a write torn by
+the kill. The differential property extends accordingly: a crashed,
+torn, recovered run's sink views must equal an uninterrupted clean
+run's (``tests/test_wal.py``).
 """
 
 from __future__ import annotations
 
+import os
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -21,7 +31,68 @@ import numpy as np
 from reflow_tpu.delta import DeltaBatch
 from reflow_tpu.graph import Node
 
-__all__ = ["FaultyChannel"]
+__all__ = ["CrashInjector", "CrashPoint", "DeliveryError", "FaultyChannel",
+           "tear_wal_tail"]
+
+
+class DeliveryError(RuntimeError):
+    """The transport observed the scheduler violating the delivery
+    contract (a duplicate accepted, or a first delivery rejected)."""
+
+
+class CrashPoint(BaseException):
+    """Simulated process death. Derives from BaseException so generic
+    ``except Exception`` recovery paths can't accidentally 'survive'
+    the kill — only the test harness catches it."""
+
+
+class CrashInjector:
+    """Raise :class:`CrashPoint` at the N-th instrumented crash seam.
+
+    ``at`` counts every visited seam; ``only`` restricts counting to
+    seams whose name contains the substring (e.g. ``"append"`` to die
+    inside the WAL write path, ``"after_push"`` to die between push and
+    tick). ``fired`` records whether the kill happened.
+    """
+
+    def __init__(self, at: int, *, only: Optional[str] = None):
+        self.remaining = at
+        self.only = only
+        self.fired = False
+        self.seams: List[str] = []
+
+    def point(self, name: str) -> None:
+        if self.fired or (self.only is not None and self.only not in name):
+            return
+        self.seams.append(name)
+        self.remaining -= 1
+        if self.remaining <= 0:
+            self.fired = True
+            raise CrashPoint(name)
+
+
+def tear_wal_tail(wal_dir: str, cut_bytes: int) -> Optional[str]:
+    """Tear the WAL's final record as a mid-write kill would: strictly
+    in the LAST segment (the only one a live writer ever touches). A
+    segment with records loses its last ``cut_bytes`` (clamped to the
+    8-byte magic header, so the tear models a torn *record*, not a
+    missing segment); a freshly-rotated empty segment instead gains a
+    partial frame (a header whose payload never landed). Returns the
+    torn segment's path, or None for an empty log."""
+    from reflow_tpu.wal.log import _MAGIC, list_segments
+
+    segs = list_segments(wal_dir)
+    if not segs:
+        return None
+    _seq, path = segs[-1]
+    size = os.path.getsize(path)
+    if size > len(_MAGIC):
+        with open(path, "rb+") as f:
+            f.truncate(max(len(_MAGIC), size - cut_bytes))
+    else:
+        with open(path, "ab") as f:
+            f.write((64).to_bytes(4, "little") + b"\0\0\0\0" + b"\xde\xad")
+    return path
 
 
 class FaultyChannel:
@@ -79,7 +150,13 @@ class FaultyChannel:
                     int(self.rng.integers(0, len(self._delivered_ids)))]
                 accepted = self.sched.push(self.source, self._batches[dup],
                                            batch_id=dup)
-                assert not accepted, "duplicate batch was folded twice"
+                if accepted:
+                    # must raise even under python -O: a silently
+                    # double-folded batch corrupts every downstream view
+                    raise DeliveryError(
+                        f"duplicate batch {dup!r} was accepted (folded "
+                        f"twice) — the scheduler's dedup window dropped "
+                        f"it; widen dedup_window or tighten redelivery")
                 self.stats["duplicated"] += 1
             if self.rng.random() < 0.3:
                 break  # partial progress per pump
@@ -88,6 +165,14 @@ class FaultyChannel:
         """Retransmit until every batch has been delivered exactly once."""
         while self._unacked:
             bid, batch = self._unacked.pop(0)
-            self.sched.push(self.source, batch, batch_id=bid)
+            accepted = self.sched.push(self.source, batch, batch_id=bid)
+            if not accepted:
+                # a queued batch was by definition never delivered, so a
+                # rejection means the dedup window claims an id the
+                # transport still holds — at-least-once just became
+                # at-most-once for this batch
+                raise DeliveryError(
+                    f"first delivery of batch {bid!r} was rejected as a "
+                    f"duplicate; its rows were never folded")
             self.stats["delivered"] += 1
             self._delivered_ids.append(bid)

@@ -15,7 +15,8 @@ from typing import List, Sequence
 
 import numpy as np
 
-__all__ = ["MetricsSummary", "summarize", "profile_trace"]
+__all__ = ["MetricsSummary", "WalMetrics", "summarize", "summarize_wal",
+           "profile_trace"]
 
 
 @dataclasses.dataclass
@@ -45,7 +46,12 @@ def summarize(history: Sequence) -> MetricsSummary:
     first — ``block()`` is idempotent and this is a sync point anyway.
     """
     if not history:
-        return MetricsSummary(0, 0, 0.0, 0.0, 0.0, 0.0, 0.0, True, 0)
+        # keyword-only on purpose: positional construction is exactly
+        # how a field addition silently shifts every later field
+        return MetricsSummary(
+            ticks=0, delta_ops=0, wall_s=0.0, delta_ops_per_s=0.0,
+            tick_p50_s=0.0, tick_p95_s=0.0, passes_mean=0.0,
+            quiesced_all=True, forced_syncs=0)
     # ONE batched device_get of every device-resident scalar first: the
     # per-record block() then hits each jax.Array's cached host value
     # instead of issuing O(ticks x fields) sequential round trips (a
@@ -79,6 +85,50 @@ def summarize(history: Sequence) -> MetricsSummary:
         quiesced_all=all(r.quiesced for r in history),
         forced_syncs=sum(bool(getattr(r, "forced_sync", False))
                          for r in history),
+    )
+
+
+@dataclasses.dataclass
+class WalMetrics:
+    """Durable-ingestion observability (``reflow_tpu.wal``): append and
+    fsync latency percentiles from the log's recorded walls, plus the
+    replay counters of a ``recovery.recover()`` run when one happened.
+    """
+
+    fsync_policy: str
+    appends: int
+    bytes_written: int
+    fsyncs: int
+    append_p50_s: float
+    append_p95_s: float
+    fsync_p50_s: float
+    fsync_p95_s: float
+    replayed_pushes: int
+    deduped_pushes: int
+    replayed_ticks: int
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def summarize_wal(wal, recovery=None) -> WalMetrics:
+    """Aggregate a ``wal.WriteAheadLog``'s counters (and optionally a
+    ``wal.RecoveryReport``'s replay counters) into one record."""
+    def pct(xs: List[float], q: float) -> float:
+        return float(np.percentile(xs, q)) if xs else 0.0
+
+    return WalMetrics(
+        fsync_policy=wal.fsync_policy,
+        appends=wal.appends,
+        bytes_written=wal.bytes_written,
+        fsyncs=wal.fsyncs,
+        append_p50_s=pct(wal.append_s, 50),
+        append_p95_s=pct(wal.append_s, 95),
+        fsync_p50_s=pct(wal.fsync_s, 50),
+        fsync_p95_s=pct(wal.fsync_s, 95),
+        replayed_pushes=getattr(recovery, "replayed_pushes", 0),
+        deduped_pushes=getattr(recovery, "deduped_pushes", 0),
+        replayed_ticks=getattr(recovery, "replayed_ticks", 0),
     )
 
 

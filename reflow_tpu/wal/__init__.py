@@ -1,0 +1,28 @@
+"""Write-ahead delta log: durable exactly-once ingestion (docs/guide.md
+"Durability and delivery").
+
+The checkpoint module snapshots state *at* a save point; everything
+pushed since is, per its own docstring, "the user's responsibility to
+replay". This package closes that gap: every accepted source batch is
+appended to a segmented, CRC-framed log *before* the scheduler accepts
+it, so a process crash between checkpoints loses nothing. Recovery
+loads the latest checkpoint and replays the log tail through the
+scheduler's existing ``push(batch_id=...)`` dedup — replay is
+idempotent by construction, so exactly-once survives process death,
+torn tail writes, and crashes between ``push`` and ``tick``.
+"""
+
+from reflow_tpu.wal.durable import DurableScheduler
+from reflow_tpu.wal.log import (LogPosition, WalError, WriteAheadLog,
+                                scan_wal)
+from reflow_tpu.wal.recovery import RecoveryReport, recover
+
+__all__ = [
+    "DurableScheduler",
+    "LogPosition",
+    "RecoveryReport",
+    "WalError",
+    "WriteAheadLog",
+    "recover",
+    "scan_wal",
+]

@@ -1,0 +1,305 @@
+"""Segmented append-only write-ahead log of pushed source batches.
+
+On-disk layout: ``<wal_dir>/wal-<seq>.log`` segment files, each starting
+with an 8-byte magic header, followed by length+CRC framed records::
+
+    [u32 payload_len][u32 crc32(payload)][payload]
+
+The payload is a pickled record dict (the same serialization the
+checkpoint module uses for host state). Three record kinds flow through
+the log:
+
+- ``push``: one accepted source batch — serialized ``DeltaBatch``
+  columns + ``batch_id`` + source node id/name + the tick horizon at
+  append time;
+- ``tick``: a tick-boundary commit marker (appended after the tick
+  completes);
+- ``ckpt``: informational marker stamped at checkpoint rotation.
+
+Durability contract by fsync policy (``fsync=``):
+
+- ``"record"``: flush + fsync after every append — survives power loss
+  per accepted batch; highest latency.
+- ``"tick"`` (default): flush per append (page cache — survives process
+  death), fsync once per tick boundary — a power loss can lose at most
+  the current in-flight tick, never a committed one.
+- ``"os"``: flush per append, never fsync — survives process death
+  only; the OS decides when bytes hit disk.
+
+A crashed process may leave a torn final record (partial write). The
+read side (:func:`scan_wal`) tolerates exactly that: a bad frame at the
+tail of the *last* segment truncates the log there; a bad frame
+anywhere else is real corruption and raises :class:`WalError`. A fresh
+:class:`WriteAheadLog` never appends to an existing segment (the tail
+may be torn) — it always opens a new one.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import re
+import struct
+import time
+import zlib
+from typing import Dict, Iterable, List, NamedTuple, Optional, Tuple
+
+__all__ = ["LogPosition", "TornTail", "WalError", "WriteAheadLog",
+           "list_segments", "scan_wal"]
+
+_MAGIC = b"RFWAL001"
+_HEADER = struct.Struct("<II")  # payload_len, crc32
+_SEG_RE = re.compile(r"^wal-(\d{8})\.log$")
+#: frame-length sanity bound — a "length" beyond this is a torn/corrupt
+#: header, not a real record (segments rotate long before this)
+_MAX_RECORD = 1 << 30
+
+
+class WalError(RuntimeError):
+    """Corruption in a sealed (non-tail) region of the log."""
+
+
+class LogPosition(NamedTuple):
+    """Byte position in the log: (segment sequence number, offset)."""
+
+    segment: int
+    offset: int
+
+
+class TornTail(NamedTuple):
+    """Where and why the tail of the last segment stopped parsing."""
+
+    segment: int
+    offset: int
+    reason: str
+
+
+def _seg_path(wal_dir: str, seq: int) -> str:
+    return os.path.join(wal_dir, f"wal-{seq:08d}.log")
+
+
+def list_segments(wal_dir: str) -> List[Tuple[int, str]]:
+    """Sorted [(seq, path)] of the segment files present in ``wal_dir``."""
+    if not os.path.isdir(wal_dir):
+        return []
+    out = []
+    for name in os.listdir(wal_dir):
+        m = _SEG_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), os.path.join(wal_dir, name)))
+    return sorted(out)
+
+
+class WriteAheadLog:
+    """Appender over a directory of rotating segment files.
+
+    Latency accounting (``utils.metrics.summarize_wal``): every append
+    and fsync wall is recorded in ``append_s`` / ``fsync_s``, and
+    ``appends`` / ``fsyncs`` / ``bytes_written`` count totals.
+    """
+
+    POLICIES = ("record", "tick", "os")
+
+    def __init__(self, wal_dir: str, *, fsync: str = "tick",
+                 segment_bytes: int = 16 << 20):
+        if fsync not in self.POLICIES:
+            raise ValueError(f"fsync policy {fsync!r} not in {self.POLICIES}")
+        self.wal_dir = wal_dir
+        self.fsync_policy = fsync
+        self.segment_bytes = segment_bytes
+        os.makedirs(wal_dir, exist_ok=True)
+        segs = list_segments(wal_dir)
+        #: torn tail repaired at open, if any (surfaced by recovery)
+        self.repaired_tail: Optional[TornTail] = None
+        if segs:
+            # self-healing open: truncate a crashed generation's torn
+            # final record to the valid prefix BEFORE opening a new
+            # segment — otherwise the tear would sit in a sealed
+            # (non-final) segment and read as corruption forever after
+            self.repaired_tail = _repair_tail(segs[-1][1], segs[-1][0])
+        # never resume an existing segment: append offsets are only
+        # known-good for a segment this process wrote start to finish
+        self._seq = (segs[-1][0] + 1) if segs else 0
+        self._f = None
+        self.appends = 0
+        self.fsyncs = 0
+        self.bytes_written = 0
+        self.append_s: List[float] = []
+        self.fsync_s: List[float] = []
+        self._open_segment()
+
+    # -- write side --------------------------------------------------------
+
+    def _open_segment(self) -> None:
+        self._f = open(_seg_path(self.wal_dir, self._seq), "wb")
+        self._f.write(_MAGIC)
+        self._f.flush()
+        self._offset = len(_MAGIC)
+
+    def append(self, record: Dict) -> LogPosition:
+        """Frame + append one record; returns its position. Honors the
+        ``"record"`` fsync policy; ``"tick"`` batches the fsync into
+        :meth:`note_tick`."""
+        t0 = time.perf_counter()
+        payload = pickle.dumps(record, protocol=pickle.HIGHEST_PROTOCOL)
+        frame = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+        pos = LogPosition(self._seq, self._offset)
+        self._f.write(frame)
+        # page cache is the floor for every policy: a killed process
+        # must never take back a record the scheduler already accepted
+        self._f.flush()
+        self._offset += len(frame)
+        self.appends += 1
+        self.bytes_written += len(frame)
+        if self.fsync_policy == "record":
+            self._fsync()
+        self.append_s.append(time.perf_counter() - t0)
+        if self._offset >= self.segment_bytes:
+            self.rotate()
+        return pos
+
+    def _fsync(self) -> None:
+        t0 = time.perf_counter()
+        os.fsync(self._f.fileno())
+        self.fsyncs += 1
+        self.fsync_s.append(time.perf_counter() - t0)
+
+    def note_tick(self) -> None:
+        """Tick-boundary durability barrier (``"tick"`` policy fsyncs
+        here; ``"record"`` already did; ``"os"`` never does)."""
+        if self.fsync_policy == "tick":
+            self._fsync()
+
+    def sync(self) -> None:
+        """Unconditional durability barrier (checkpoint path)."""
+        self._f.flush()
+        self._fsync()
+
+    def position(self) -> LogPosition:
+        """Position one past the last appended byte."""
+        return LogPosition(self._seq, self._offset)
+
+    def rotate(self) -> None:
+        """Seal the current segment and open the next one."""
+        self._f.flush()
+        self._f.close()
+        self._seq += 1
+        self._open_segment()
+
+    def truncate_until(self, pos: LogPosition) -> List[str]:
+        """Delete sealed segments strictly before ``pos.segment`` (the
+        checkpoint already covers them). Returns the removed paths."""
+        removed = []
+        for seq, path in list_segments(self.wal_dir):
+            if seq < pos.segment and seq != self._seq:
+                os.remove(path)
+                removed.append(path)
+        return removed
+
+    def close(self) -> None:
+        if self._f is not None and not self._f.closed:
+            self._f.flush()
+            self._fsync()
+            self._f.close()
+
+
+# -- read side -------------------------------------------------------------
+
+def _valid_prefix(data: bytes) -> int:
+    """Byte length of the longest valid record prefix (past the magic);
+    -1 when even the magic is gone."""
+    if data[:len(_MAGIC)] != _MAGIC:
+        return -1
+    off = len(_MAGIC)
+    while off + _HEADER.size <= len(data):
+        length, crc = _HEADER.unpack_from(data, off)
+        payload = data[off + _HEADER.size: off + _HEADER.size + length]
+        if (length > _MAX_RECORD or len(payload) < length
+                or zlib.crc32(payload) != crc):
+            break
+        off += _HEADER.size + length
+    return off
+
+
+def _repair_tail(path: str, seq: int) -> Optional[TornTail]:
+    """Truncate ``path`` to its valid record prefix (drop a torn final
+    record); delete it outright if even the magic header is torn.
+    Returns what was repaired, or None for an already-clean segment."""
+    with open(path, "rb") as f:
+        data = f.read()
+    keep = _valid_prefix(data)
+    if keep == len(data):
+        return None
+    if keep < 0:
+        os.remove(path)
+        return TornTail(seq, 0, "segment magic torn; segment removed")
+    with open(path, "rb+") as f:
+        f.truncate(keep)
+    return TornTail(seq, keep,
+                    f"torn record truncated ({len(data) - keep} bytes)")
+
+def _read_segment(path: str, seq: int, is_last: bool,
+                  ) -> Tuple[List[Tuple[LogPosition, Dict]],
+                             Optional[TornTail]]:
+    records: List[Tuple[LogPosition, Dict]] = []
+
+    def bad(offset: int, reason: str):
+        if is_last:
+            return records, TornTail(seq, offset, reason)
+        raise WalError(f"{path} @ {offset}: {reason} in a sealed "
+                       f"(non-final) segment — real corruption, not a "
+                       f"torn tail")
+
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:len(_MAGIC)] != _MAGIC:
+        return bad(0, f"bad segment magic {data[:len(_MAGIC)]!r}")
+    off = len(_MAGIC)
+    while off < len(data):
+        if off + _HEADER.size > len(data):
+            return bad(off, "truncated frame header")
+        length, crc = _HEADER.unpack_from(data, off)
+        if length > _MAX_RECORD:
+            return bad(off, f"implausible frame length {length}")
+        payload = data[off + _HEADER.size: off + _HEADER.size + length]
+        if len(payload) < length:
+            return bad(off, f"truncated payload ({len(payload)}/{length} "
+                            f"bytes)")
+        if zlib.crc32(payload) != crc:
+            return bad(off, "CRC mismatch")
+        try:
+            record = pickle.loads(payload)
+        except Exception as e:  # noqa: BLE001 - framed+CRC-clean yet unloadable
+            return bad(off, f"unpicklable payload ({e})")
+        records.append((LogPosition(seq, off), record))
+        off += _HEADER.size + length
+    return records, None
+
+
+def scan_wal(wal_dir: str, start: Optional[Tuple[int, int]] = None,
+             ) -> Tuple[List[Tuple[LogPosition, Dict]], Optional[TornTail]]:
+    """Parse every record at or after ``start`` ((segment, offset), e.g.
+    a checkpoint's recorded position). Returns ``(records, torn)`` where
+    ``torn`` describes a tolerated torn tail in the final segment (None
+    for a clean log). Raises :class:`WalError` on non-tail corruption.
+    """
+    segs = list_segments(wal_dir)
+    records: List[Tuple[LogPosition, Dict]] = []
+    torn: Optional[TornTail] = None
+    for ix, (seq, path) in enumerate(segs):
+        if start is not None and seq < start[0]:
+            continue
+        seg_records, torn = _read_segment(path, seq, ix == len(segs) - 1)
+        for pos, rec in seg_records:
+            if start is not None and pos.segment == start[0] \
+                    and pos.offset < start[1]:
+                continue
+            records.append((pos, rec))
+    return records, torn
+
+
+def iter_push_records(records: Iterable[Tuple[LogPosition, Dict]]):
+    """The push records of a scan, in log order."""
+    for pos, rec in records:
+        if rec.get("kind") == "push":
+            yield pos, rec

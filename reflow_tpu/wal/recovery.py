@@ -1,0 +1,127 @@
+"""Crash recovery: checkpoint restore + WAL tail replay.
+
+``recover(sched, wal_dir, ckpt_dir)`` rebuilds a crashed process's
+scheduler in two moves:
+
+1. **Restore** the latest checkpoint (if one exists) — operator state,
+   sink views, tick counter, dedup window, pending batches — and take
+   its recorded WAL position as the replay start.
+2. **Replay** the WAL tail through the scheduler's ordinary
+   ``push(batch_id=...)`` / ``tick()`` path. Idempotence needs no new
+   machinery: a push whose id the restored dedup window already holds
+   is dropped by the same code that drops a lossy transport's
+   duplicates, and a tick marker at or below the restored tick counter
+   is skipped. Execution is deterministic from the restored state, so
+   the re-run ticks reproduce exactly the sink deltas the crashed
+   process produced.
+
+Pushes logged after the last tick marker (a crash between ``push`` and
+``tick``) land back in the pending buffers, exactly where the crash
+left them; the next ``tick()`` folds them once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+from reflow_tpu.delta import DeltaBatch
+from reflow_tpu.wal.log import TornTail, scan_wal
+
+__all__ = ["RecoveryReport", "recover"]
+
+
+@dataclasses.dataclass
+class RecoveryReport:
+    """What a ``recover()`` call did (metrics.summarize_wal merges
+    these counters into the WAL metrics record)."""
+
+    checkpoint_loaded: bool
+    checkpoint_tick: int
+    wal_records: int
+    replayed_pushes: int
+    deduped_pushes: int
+    replayed_ticks: int
+    skipped_ticks: int
+    torn_tail: Optional[TornTail]
+    final_tick: int
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["torn_tail"] = (self.torn_tail._asdict()
+                          if self.torn_tail is not None else None)
+        return d
+
+
+def _resolve_source(sched, rec):
+    node = sched.graph.nodes[rec["node"]]
+    if node.name != rec["node_name"]:
+        raise ValueError(
+            f"WAL push record for node #{rec['node']} named "
+            f"{rec['node_name']!r}, but the recovering graph has "
+            f"{node.name!r} there — recover() needs the same graph the "
+            f"log was written against")
+    return node
+
+
+def recover(sched, wal_dir: str, ckpt_dir: Optional[str] = None,
+            ) -> RecoveryReport:
+    """Restore ``sched`` (fresh, same graph/executor as the crashed run)
+    from the latest checkpoint plus the WAL tail. Works on a plain
+    ``DirtyScheduler`` or a ``DurableScheduler`` (whose re-logging is
+    suspended during replay — the tail segments stay authoritative
+    until the next checkpoint truncates them)."""
+    from reflow_tpu.utils.checkpoint import load_checkpoint
+
+    start = None
+    ckpt_loaded = False
+    ckpt_tick = 0
+    if ckpt_dir is not None and os.path.exists(
+            os.path.join(ckpt_dir, "meta.pkl")):
+        meta = load_checkpoint(sched, ckpt_dir)
+        ckpt_loaded = True
+        ckpt_tick = sched._tick
+        start = meta.get("wal_pos")
+
+    records, torn = scan_wal(wal_dir, start=start)
+    if torn is None:
+        # a DurableScheduler already repaired the crashed generation's
+        # torn tail when it opened the log; surface that here
+        torn = getattr(getattr(sched, "wal", None), "repaired_tail", None)
+    replayed = deduped = ticks_done = ticks_skipped = 0
+    suspended = getattr(sched, "_wal_suspended", None)
+    if suspended is not None:
+        sched._wal_suspended = True
+    try:
+        for _pos, rec in records:
+            kind = rec.get("kind")
+            if kind == "push":
+                batch = DeltaBatch(rec["keys"], rec["values"],
+                                   rec["weights"])
+                if sched.push(_resolve_source(sched, rec), batch,
+                              batch_id=rec["batch_id"]):
+                    replayed += 1
+                else:
+                    deduped += 1
+            elif kind == "tick":
+                if rec["tick"] > sched._tick:
+                    sched.tick()
+                    ticks_done += 1
+                else:
+                    ticks_skipped += 1
+            # "ckpt" and unknown kinds: informational, skip
+    finally:
+        if suspended is not None:
+            sched._wal_suspended = False
+    return RecoveryReport(
+        checkpoint_loaded=ckpt_loaded,
+        checkpoint_tick=ckpt_tick,
+        wal_records=len(records),
+        replayed_pushes=replayed,
+        deduped_pushes=deduped,
+        replayed_ticks=ticks_done,
+        skipped_ticks=ticks_skipped,
+        torn_tail=torn,
+        final_tick=sched._tick,
+    )

@@ -1,0 +1,67 @@
+"""utils/runtime.note_forced_sync: the one-time tunnel-degradation
+advisory — warns exactly once per process on a tunnel runtime, never
+when JAX is forced to CPU (this suite's conftest does exactly that)."""
+
+import warnings
+
+import pytest
+
+import reflow_tpu.utils.runtime as rt
+
+
+@pytest.fixture(autouse=True)
+def reset_warned():
+    """The advisory is once-per-PROCESS state; isolate each case."""
+    old = rt._warned
+    rt._warned = False
+    yield
+    rt._warned = old
+
+
+def test_warns_exactly_once_on_tunnel_runtime(monkeypatch):
+    monkeypatch.setattr(rt, "_tunnel_active", lambda: True)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        rt.note_forced_sync("first readback")
+        rt.note_forced_sync("second readback")
+        rt.note_forced_sync("third readback")
+    assert len(caught) == 1, [str(w.message) for w in caught]
+    msg = str(caught[0].message)
+    assert "first readback" in msg and "tick(sync=False)" in msg
+
+
+def test_never_warns_when_jax_forced_to_cpu(monkeypatch):
+    # the axon plugin can be importable/registered while the backend is
+    # forced to CPU (conftest.py) — no degradation happens, no warning
+    monkeypatch.setattr(rt, "remote_tunnel_runtime", lambda: True)
+    import jax
+
+    assert jax.default_backend() == "cpu"
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        rt.note_forced_sync("cpu readback")
+        rt.note_forced_sync("cpu readback again")
+    assert caught == []
+
+
+def test_scheduler_counts_but_advisory_stays_quiet_on_cpu():
+    """The forced-sync COUNTER still advances on the CPU oracle path
+    (read_table on a cpu executor is not a forced sync at all)."""
+    import numpy as np
+
+    from reflow_tpu import DirtyScheduler, FlowGraph
+    from reflow_tpu.delta import DeltaBatch, Spec
+
+    g = FlowGraph()
+    src = g.source("s", Spec((), np.float32, key_space=8))
+    red = g.reduce(src, "sum")
+    g.sink(red, "out")
+    sched = DirtyScheduler(g)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        sched.push(src, DeltaBatch(np.array([1]),
+                                   np.array([2.0], np.float32)))
+        sched.tick()
+        sched.read_table(red)
+    assert sched.forced_syncs == 0  # cpu executor: no forced syncs
+    assert caught == []
