@@ -52,6 +52,12 @@ Env knobs::
                                   fsync policy + time-to-first-tick after a
                                   simulated crash (CPU-only, no tunnel)
     REFLOW_BENCH_RECOVERY_TICKS   crash-backlog size  (default 1000)
+    REFLOW_BENCH_SERVE=1          serve mode instead: IngestFrontend
+                                  sustained throughput at 1/4/16 concurrent
+                                  producers vs the bare push+tick loop,
+                                  coalesce factor, zero forced syncs
+                                  (CPU-only, no tunnel)
+    REFLOW_BENCH_SERVE_BATCHES    micro-batches per producer (default 250)
 """
 
 from __future__ import annotations
@@ -218,6 +224,101 @@ def run_recovery_bench() -> dict:
         log("recovery:", json.dumps(report.as_dict()))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+# -- serve / ingestion-frontend mode (REFLOW_BENCH_SERVE=1) ----------------
+
+def run_serve_bench() -> dict:
+    """Ingestion-frontend numbers (docs/guide.md "Serving ingestion"):
+    sustained micro-batch throughput through ``IngestFrontend`` at
+    1 / 4 / 16 concurrent producers vs the bare single-threaded
+    ``push()+tick()`` loop on the same workload, plus the coalescing
+    factor (micro-batches folded per scheduler tick) and the
+    zero-forced-syncs check (the pump only ever calls ``tick_many``).
+
+    Host-side end to end (admission/coalescing are host-boundary
+    machinery); runs on the CPU executor so no tunnel protocol applies.
+    """
+    import threading
+
+    from reflow_tpu.scheduler import DirtyScheduler
+    from reflow_tpu.serve import CoalesceWindow, IngestFrontend
+    from reflow_tpu.utils.metrics import summarize, summarize_serve
+    from reflow_tpu.workloads import wordcount
+
+    smoke = os.environ.get("REFLOW_BENCH_SMOKE") == "1"
+    per_producer = int(os.environ.get(
+        "REFLOW_BENCH_SERVE_BATCHES", "40" if smoke else "250"))
+    rows_per_batch = 8
+
+    def make_lines(producer: int, j: int) -> list:
+        rng = np.random.default_rng(producer * 100_003 + j)
+        return [" ".join(f"w{int(x)}"
+                         for x in rng.integers(0, 1000, rows_per_batch))]
+
+    out = {"per_producer_batches": per_producer,
+           "rows_per_batch": rows_per_batch}
+
+    # bare-loop baseline: one thread, one tick per micro-batch
+    g, src, _sink = wordcount.build_graph()
+    sched = DirtyScheduler(g)
+    t0 = time.perf_counter()
+    for j in range(per_producer):
+        sched.push(src, wordcount.ingest_lines(make_lines(0, j)))
+        sched.tick()
+    bare_s = time.perf_counter() - t0
+    bare_rate = per_producer * rows_per_batch / bare_s
+    out["bare_loop_rows_per_s"] = round(bare_rate)
+    log(f"bare loop: {per_producer} batches in {bare_s:.3f}s "
+        f"({bare_rate:.0f} rows/s)")
+
+    for n_prod in (1, 4, 16):
+        g, src, _sink = wordcount.build_graph()
+        sched = DirtyScheduler(g)
+        fe = IngestFrontend(sched, window=CoalesceWindow(
+            max_rows=4096, max_ticks=8, max_latency_s=0.005))
+        tickets = []
+        tk_lock = threading.Lock()
+
+        def produce(pid, fe=fe, src=src):
+            mine = [fe.submit(src, wordcount.ingest_lines(
+                make_lines(pid, j))) for j in range(per_producer)]
+            with tk_lock:
+                tickets.extend(mine)
+
+        threads = [threading.Thread(target=produce, args=(pid,))
+                   for pid in range(n_prod)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        fe.flush()
+        wall = time.perf_counter() - t0
+        assert all(t.result(timeout=10).applied for t in tickets)
+        sm = summarize_serve(fe)
+        ms = summarize(sched.history)
+        fe.close()
+        n_batches = n_prod * per_producer
+        rate = n_batches * rows_per_batch / wall
+        out[f"serve_{n_prod}p_rows_per_s"] = round(rate)
+        out[f"serve_{n_prod}p_vs_bare_x"] = round(rate / bare_rate, 3)
+        out[f"serve_{n_prod}p_coalesce_factor"] = round(
+            sm.coalesce_factor, 2)
+        out[f"serve_{n_prod}p_ticks"] = sm.ticks
+        out[f"serve_{n_prod}p_admission_p95_us"] = round(
+            sm.admission_p95_s * 1e6, 1)
+        out[f"serve_{n_prod}p_forced_syncs"] = ms.forced_syncs
+        log(f"serve[{n_prod}p]: {n_batches} batches in {wall:.3f}s "
+            f"({rate:.0f} rows/s, {out[f'serve_{n_prod}p_vs_bare_x']}x "
+            f"bare; coalesce {sm.coalesce_factor:.2f} over {sm.ticks} "
+            f"ticks; forced_syncs={ms.forced_syncs})")
+    # the acceptance pair: heavy concurrency must actually coalesce, and
+    # the pump must never have forced a mid-stream sync
+    out["coalesce_gt_1_at_16p"] = out["serve_16p_coalesce_factor"] > 1.0
+    out["zero_forced_syncs"] = all(
+        out[f"serve_{n}p_forced_syncs"] == 0 for n in (1, 4, 16))
     return out
 
 
@@ -521,6 +622,18 @@ def _spawn(name: str) -> dict:
 
 
 def main() -> None:
+    if os.environ.get("REFLOW_BENCH_SERVE") == "1":
+        # serve mode is host-side CPU work — no tunnel, no subprocesses
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        out = run_serve_bench()
+        print(json.dumps({
+            "metric": "serve_ingest_rows_per_s_16_producers",
+            "value": out["serve_16p_rows_per_s"],
+            "unit": "rows/s",
+            **out,
+        }))
+        return
+
     if os.environ.get("REFLOW_BENCH_RECOVERY") == "1":
         # WAL mode is host-side CPU work — no tunnel, no subprocesses
         os.environ.setdefault("JAX_PLATFORMS", "cpu")

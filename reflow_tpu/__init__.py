@@ -25,6 +25,7 @@ from reflow_tpu.delta import DeltaBatch, Spec
 from reflow_tpu.graph import FlowGraph
 from reflow_tpu.scheduler import DirtyScheduler
 from reflow_tpu.executors import CpuExecutor, Executor, get_executor
+from reflow_tpu.serve import IngestFrontend
 from reflow_tpu.utils.config import ReflowConfig
 from reflow_tpu.wal import DurableScheduler, recover
 
@@ -38,6 +39,7 @@ __all__ = [
     "DurableScheduler",
     "Executor",
     "CpuExecutor",
+    "IngestFrontend",
     "get_executor",
     "recover",
     "ReflowConfig",

@@ -219,12 +219,8 @@ class DirtyScheduler:
         """
         if source.kind not in ("source", "loop"):
             raise GraphError(f"can only push to sources/loops, not {source}")
-        if batch_id is not None:
-            if batch_id in self._seen_batch_ids:
-                return False
-            self._seen_batch_ids[batch_id] = None
-            while len(self._seen_batch_ids) > self.dedup_window:
-                self._seen_batch_ids.pop(next(iter(self._seen_batch_ids)))
+        if batch_id is not None and not self._register_batch_id(batch_id):
+            return False
         # device-resident batches are enqueued unconditionally: their
         # len() is a device->host readback (DeviceDelta.__len__), and any
         # readback permanently degrades a tunnel-attached runtime's
@@ -232,6 +228,19 @@ class DirtyScheduler:
         if not hasattr(batch, "nonzero") and not len(batch):
             return True
         self._pending[source.id].append(batch)
+        return True
+
+    def _register_batch_id(self, batch_id: str) -> bool:
+        """Record ``batch_id`` in the bounded dedup window. Returns False
+        (without touching the window) when the id is already held — a
+        replay inside the horizon. Eviction is pure insertion order: a
+        rejected replay does NOT refresh its id's position, so the
+        horizon is "newest ``dedup_window`` *accepted* ids"."""
+        if batch_id in self._seen_batch_ids:
+            return False
+        self._seen_batch_ids[batch_id] = None
+        while len(self._seen_batch_ids) > self.dedup_window:
+            self._seen_batch_ids.pop(next(iter(self._seen_batch_ids)))
         return True
 
     # -- dirty planning (structural) --------------------------------------
@@ -381,13 +390,23 @@ class DirtyScheduler:
         self.history.append(result)
         return result
 
-    def tick_many(self, feeds: Sequence[Dict[Node, DeltaBatch]]
-                  ) -> TickResult:
+    def tick_many(self, feeds: Sequence[Dict[Node, DeltaBatch]], *,
+                  feed_ids: Optional[Sequence[Dict[Node, Sequence[str]]]]
+                  = None) -> TickResult:
         """K consecutive streaming ticks, fused into ONE device execution
         when the executor supports it (the macro-tick; see
         ``TpuExecutor.run_tick_fixpoint_many``). ``feeds[t]`` is tick
         ``t``'s source-push set; semantics are identical to pushing and
         ticking each feed in order with ``sync=False``.
+
+        ``feed_ids`` (parallel to ``feeds``) carries the producer batch
+        ids a coalesced feed entry commits — the serving frontend merges
+        several ``submit()`` micro-batches into one feed batch, and their
+        ids must land in the dedup window atomically with the macro-tick
+        so replays dedup exactly as ``push(batch_id=...)`` replays do.
+        Ids are *recorded*, not filtered: the caller (the frontend's
+        admission path) is responsible for rejecting duplicates before
+        coalescing.
 
         Returns ONE aggregated TickResult covering all K ticks (scalar
         fields sum/all-combine at ``block()``). Falls back to the
@@ -398,6 +417,15 @@ class DirtyScheduler:
         if any(self._pending.values()):
             raise GraphError("tick_many cannot run with pending push()ed "
                              "batches; tick() them first")
+        if feed_ids is not None:
+            if len(feed_ids) != len(feeds):
+                raise GraphError(
+                    f"feed_ids must parallel feeds "
+                    f"({len(feed_ids)} != {len(feeds)})")
+            for ids_map in feed_ids:
+                for ids in ids_map.values():
+                    for bid in ids:
+                        self._register_batch_id(bid)
         feeds = [{src.id: b for src, b in f.items()} for f in feeds]
         for f in feeds:
             for nid in f:

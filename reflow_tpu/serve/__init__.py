@@ -1,0 +1,21 @@
+"""Streaming ingestion frontend: concurrent producers → macro-ticks.
+
+``IngestFrontend`` owns a scheduler on a dedicated pump thread and
+exposes a thread-safe ``submit() -> Ticket`` to any number of
+producers, with backpressure, micro-batch coalescing, exactly-once
+admission, and graceful drain/close. See ``docs/guide.md`` ("Serving
+ingestion") for the tour.
+"""
+
+from .coalesce import CoalesceWindow, Feed, build_feeds
+from .frontend import IngestFrontend
+from .queues import batch_nbytes
+from .tickets import (APPLIED, DEDUPED, REJECTED, SHED, FrontendClosed,
+                      PumpCrashed, Ticket, TicketResult)
+
+__all__ = [
+    "APPLIED", "DEDUPED", "REJECTED", "SHED",
+    "CoalesceWindow", "Feed", "FrontendClosed", "IngestFrontend",
+    "PumpCrashed", "Ticket", "TicketResult", "batch_nbytes",
+    "build_feeds",
+]

@@ -1,0 +1,434 @@
+"""IngestFrontend: backpressured multi-producer admission onto one
+scheduler.
+
+The paper's tick-synchronous model assumes *someone* feeds the
+scheduler; this is that someone. N concurrent producers call
+``submit(source, batch)`` from their own threads; a single **pump
+thread** owns the scheduler (``DirtyScheduler`` or ``DurableScheduler``
+— never touch it directly while the frontend is running), coalesces the
+queued micro-batches into ``tick_many`` macro-ticks, and resolves each
+submission's :class:`~reflow_tpu.serve.tickets.Ticket`.
+
+Admission control (per submit, in order):
+
+1. **id mint / dedup** — a missing ``batch_id`` is minted through
+   ``SourceCursor`` (restart-safe: cursors resume past the scheduler's
+   recovered dedup window); a duplicate id resolves the ticket
+   ``DEDUPED`` immediately, never silently dropped.
+2. **backpressure** — per-source queue depth + global in-flight byte
+   budget, with the configured policy: ``block`` (wait for room; a
+   ``close()`` releases blocked producers with :class:`FrontendClosed`),
+   ``reject`` (resolve ``REJECTED`` now), ``shed-oldest`` (evict the
+   oldest admitted entries — their tickets resolve ``SHED`` — to admit
+   the newer one).
+
+Steady-state traffic rides the fused streaming path: the pump calls
+``tick_many`` (never a synchronous ``tick``), so on a device executor
+no mid-stream forced syncs happen — the zero-``forced_syncs`` property
+``REFLOW_BENCH_SERVE=1`` asserts.
+
+Crash seams (``utils.faults.CrashInjector``): ``producer_submit`` /
+``producer_admitted`` on the submitting thread, ``pump_coalesce`` /
+``pump_before_tick`` / ``pump_after_tick`` on the pump. A pump kill
+fails every undecided ticket with :class:`PumpCrashed` and releases
+blocked producers; a durable scheduler's WAL then carries exactly-once
+across ``recover()`` + upstream re-send.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from reflow_tpu.graph import GraphError, Node
+from reflow_tpu.scheduler import SourceCursor
+
+from .coalesce import CoalesceWindow, build_feeds
+from .queues import Entry, SourceQueues, batch_nbytes
+from .tickets import (APPLIED, DEDUPED, REJECTED, SHED, FrontendClosed,
+                      PumpCrashed, Ticket, TicketResult)
+
+__all__ = ["IngestFrontend"]
+
+POLICIES = ("block", "reject", "shed-oldest")
+
+
+class IngestFrontend:
+    """Thread-safe streaming ingestion frontend over one scheduler.
+
+    ``policy``: backpressure policy (``block`` / ``reject`` /
+    ``shed-oldest``). ``queue_batches``: per-source queue bound.
+    ``max_bytes``: global in-flight payload budget. ``window``: the
+    coalescing window (rows / ticks / latency triggers). ``crash``: a
+    ``CrashInjector`` wired to the documented seams (tests only).
+    """
+
+    def __init__(self, sched, *, policy: str = "block",
+                 queue_batches: int = 256, max_bytes: int = 64 << 20,
+                 window: Optional[CoalesceWindow] = None, crash=None,
+                 start: bool = True):
+        if policy not in POLICIES:
+            raise ValueError(f"policy {policy!r} not in {POLICIES}")
+        self.sched = sched
+        self.policy = policy
+        self.window = window if window is not None else CoalesceWindow()
+        self._crash = crash
+        self._lock = threading.Lock()
+        self._not_full = threading.Condition(self._lock)   # producers
+        self._work = threading.Condition(self._lock)       # pump
+        self._idle = threading.Condition(self._lock)       # flush/pause
+        self._queues = SourceQueues(queue_batches, max_bytes)
+        self._cursors: Dict[int, SourceCursor] = {}
+        #: admission-side mirror of the scheduler's dedup window (the
+        #: pump owns the scheduler, so producers can't read it): seeded
+        #: from the (possibly recovered) window, bounded the same way
+        self._admitted: Dict[str, None] = dict.fromkeys(
+            sched._seen_batch_ids)
+        self._state = "running"
+        self._closing_flush = True
+        self._paused = False
+        self._executing = False
+        self._flush_pending = False
+        self.pump_error: Optional[BaseException] = None
+        # -- counters/samples (utils.metrics.summarize_serve) --
+        self.submitted = 0
+        self.admitted = 0
+        self.applied = 0
+        self.deduped = 0
+        self.rejected = 0
+        self.shed = 0
+        self.ticks = 0
+        self.pump_iterations = 0
+        self.queue_depth_samples: List[int] = []
+        self.admission_s: List[float] = []
+        self.ticks_per_pump: List[int] = []
+        self.inflight_bytes_peak = 0
+        self._thread = threading.Thread(
+            target=self._pump_loop, name="reflow-ingest-pump", daemon=True)
+        if start:
+            self._thread.start()
+
+    # -- crash seams -------------------------------------------------------
+
+    def _crash_point(self, name: str) -> None:
+        if self._crash is not None:
+            self._crash.point(name)
+
+    # -- producer side -----------------------------------------------------
+
+    def submit(self, source: Node, batch, *, batch_id: Optional[str] = None,
+               timeout: Optional[float] = None) -> Ticket:
+        """Admit one micro-batch for ``source``; returns a Ticket that
+        resolves once the batch's fate is decided. Thread-safe; callable
+        from any number of producers. ``timeout`` bounds a ``block``
+        admission wait (expiry resolves the ticket REJECTED)."""
+        if source.kind not in ("source", "loop"):
+            raise GraphError(
+                f"can only submit to sources/loops, not {source}")
+        t0 = time.perf_counter()
+        deadline = None if timeout is None else t0 + timeout
+        with self._lock:
+            self._crash_point("producer_submit")
+            self.submitted += 1
+            if self._state != "running":
+                raise FrontendClosed(
+                    f"frontend is {self._state}; submissions not accepted")
+            if batch_id is None:
+                batch_id = self._cursor(source).next_id()
+            ticket = Ticket(batch_id)
+            if batch_id in self._admitted:
+                self.deduped += 1
+                ticket._resolve(TicketResult(
+                    DEDUPED, batch_id,
+                    reason="batch_id already admitted"))
+                return ticket
+            device = hasattr(batch, "nonzero")
+            rows = 0 if device else len(batch)
+            if not device and rows == 0:
+                # an empty host batch is a semantic no-op; report it
+                # applied rather than occupying a queue slot
+                self._note_admitted(batch_id)
+                ticket._resolve(TicketResult(APPLIED, batch_id,
+                                             reason="empty batch"))
+                return ticket
+            nbytes = batch_nbytes(batch)
+            if not self._admit(source, nbytes, ticket, batch_id, deadline):
+                return ticket  # ticket already resolved REJECTED/…
+            entry = Entry(ticket, source, batch, batch_id, nbytes,
+                          time.perf_counter(), device, rows)
+            self._note_admitted(batch_id)
+            self._queues.push(entry)
+            self.admitted += 1
+            self.admission_s.append(time.perf_counter() - t0)
+            self.queue_depth_samples.append(self._queues.queued_batches)
+            self.inflight_bytes_peak = max(
+                self.inflight_bytes_peak,
+                self._queues.queued_bytes + self._queues.executing_bytes)
+            self._work.notify()
+            self._crash_point("producer_admitted")
+        return ticket
+
+    def _admit(self, source: Node, nbytes: int, ticket: Ticket,
+               batch_id: str, deadline: Optional[float]) -> bool:
+        # caller holds the lock; resolves the ticket and returns False
+        # when admission is refused
+        while not self._queues.room_for(source.id, nbytes):
+            if self.policy == "reject":
+                self.rejected += 1
+                ticket._resolve(TicketResult(
+                    REJECTED, batch_id, reason="backpressure: queue full"))
+                return False
+            if self.policy == "shed-oldest":
+                if not self._queues.fits_alone(nbytes):
+                    self.rejected += 1
+                    ticket._resolve(TicketResult(
+                        REJECTED, batch_id,
+                        reason=f"batch of {nbytes}B exceeds the "
+                               f"{self._queues.max_bytes}B budget"))
+                    return False
+                for e in self._queues.shed_for(source.id, nbytes):
+                    self.shed += 1
+                    e.ticket._resolve(TicketResult(
+                        SHED, e.batch_id,
+                        reason="shed-oldest backpressure; re-send"))
+                if self._queues.room_for(source.id, nbytes):
+                    return True
+                # executing bytes hold the budget: fall through to wait
+            # block (and shed-oldest squeezed by in-flight execution)
+            remaining = (None if deadline is None
+                         else deadline - time.perf_counter())
+            if remaining is not None and remaining <= 0:
+                self.rejected += 1
+                ticket._resolve(TicketResult(
+                    REJECTED, batch_id,
+                    reason="backpressure: admission timed out"))
+                return False
+            if not self._not_full.wait(timeout=remaining):
+                self.rejected += 1
+                ticket._resolve(TicketResult(
+                    REJECTED, batch_id,
+                    reason="backpressure: admission timed out"))
+                return False
+            if self._state != "running":
+                raise FrontendClosed(
+                    "frontend closed while blocked on admission")
+        return True
+
+    def _cursor(self, source: Node) -> SourceCursor:
+        cur = self._cursors.get(source.id)
+        if cur is None:
+            cur = self._cursors[source.id] = SourceCursor.resume(
+                self.sched, source)
+        return cur
+
+    def _note_admitted(self, batch_id: str) -> None:
+        self._admitted[batch_id] = None
+        while len(self._admitted) > self.sched.dedup_window:
+            self._admitted.pop(next(iter(self._admitted)))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Block until every batch admitted so far has been ticked."""
+        deadline = (None if timeout is None
+                    else time.perf_counter() + timeout)
+        with self._lock:
+            if self._state == "failed":
+                raise PumpCrashed(f"pump died: {self.pump_error!r}")
+            if self._paused:
+                raise GraphError("flush() while paused would never "
+                                 "complete; resume() first")
+            self._flush_pending = True
+            self._work.notify()
+            try:
+                while self._queues.queued_batches or self._executing:
+                    if self._state == "failed":
+                        raise PumpCrashed(
+                            f"pump died: {self.pump_error!r}")
+                    if self._state == "closed":
+                        return
+                    remaining = (None if deadline is None
+                                 else deadline - time.perf_counter())
+                    if remaining is not None and remaining <= 0:
+                        raise TimeoutError("flush timed out")
+                    self._idle.wait(timeout=remaining)
+            finally:
+                self._flush_pending = False
+
+    def drain(self, source: Optional[Node] = None, *, max_ticks: int = 256,
+              probe_rows: int = 1) -> int:
+        """Flush, then run the scheduler's ``drain`` (deferred-fixpoint
+        residue) with the pump paused. ``source`` defaults to the
+        graph's sole source; pass one explicitly on multi-source graphs.
+        Returns the scheduler drain's tick count."""
+        if source is None:
+            srcs = [n for n in self.sched.graph.nodes
+                    if n.kind == "source"]
+            if len(srcs) != 1:
+                raise GraphError(
+                    f"drain needs an explicit source on a graph with "
+                    f"{len(srcs)} sources")
+            source = srcs[0]
+        self.flush()
+        self.pause()
+        try:
+            return self.sched.drain(source, max_ticks=max_ticks,
+                                    probe_rows=probe_rows)
+        finally:
+            self.resume()
+
+    def pause(self) -> None:
+        """Stop pumping (admission continues to queue); returns once the
+        in-flight macro-tick (if any) completes. The scheduler may then
+        be inspected/driven directly until :meth:`resume`."""
+        with self._lock:
+            self._paused = True
+            while self._executing:
+                self._idle.wait()
+
+    def resume(self) -> None:
+        with self._lock:
+            self._paused = False
+            self._work.notify()
+
+    def close(self, *, flush: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Quiesce and shut down: stop admission, release blocked
+        producers with :class:`FrontendClosed`, tick out the remaining
+        backlog (``flush=True``) or fail its tickets (``flush=False``),
+        stop the pump, and seal a durable scheduler's WAL. Idempotent.
+        """
+        with self._lock:
+            if self._state in ("closed", "failed"):
+                self._seal()
+                return
+            self._closing_flush = flush and self._state == "running"
+            self._state = "closing"
+            self._paused = False
+            self._not_full.notify_all()
+            self._work.notify_all()
+        if self._thread.is_alive():
+            self._thread.join(timeout=timeout)
+        with self._lock:
+            if self._state != "failed":
+                self._state = "closed"
+            self._idle.notify_all()
+        self._seal()
+
+    def _seal(self) -> None:
+        closefn = getattr(self.sched, "close", None)
+        if closefn is not None:
+            closefn()
+
+    def __enter__(self) -> "IngestFrontend":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(flush=exc == (None, None, None))
+
+    # -- the pump ----------------------------------------------------------
+
+    def _fire_or_timeout(self, now: float):
+        # under lock: (fire, wait_timeout)
+        if self._state == "closing":
+            return True, None
+        if self._paused or self._queues.queued_batches == 0:
+            return False, None
+        if self._flush_pending:
+            return True, None
+        w = self.window
+        if self._queues.queued_rows >= w.max_rows:
+            return True, None
+        if self._queues.pending_feed_rounds(w.max_rows) >= w.max_ticks:
+            return True, None
+        oldest = self._queues.oldest_t()
+        age = now - oldest if oldest is not None else 0.0
+        if age >= w.max_latency_s:
+            return True, None
+        return False, w.max_latency_s - age
+
+    def _pump_loop(self) -> None:
+        try:
+            while True:
+                with self._lock:
+                    while True:
+                        if self._state == "closing" and (
+                                not self._closing_flush
+                                or self._queues.queued_batches == 0):
+                            self._exit_pump_locked()
+                            return
+                        fire, wait_t = self._fire_or_timeout(
+                            time.perf_counter())
+                        if fire:
+                            break
+                        self._work.wait(timeout=wait_t)
+                    drained = self._queues.drain_all()
+                    self._flush_pending = False
+                    self._executing = True
+                self._run_window(drained)
+                with self._lock:
+                    self._executing = False
+                    self._queues.commit_executing()
+                    self._not_full.notify_all()
+                    self._idle.notify_all()
+        except BaseException as e:  # noqa: BLE001 - incl. CrashPoint kills
+            self._on_pump_crash(e)
+
+    def _exit_pump_locked(self) -> None:
+        # caller holds the lock; fail whatever close(flush=False) strands
+        stranded = self._queues.drain_all()
+        self._queues.commit_executing()
+        for entries in stranded.values():
+            for e in entries:
+                e.ticket._fail(FrontendClosed(
+                    f"frontend closed before batch {e.batch_id!r} "
+                    f"was ticked"))
+        self._idle.notify_all()
+        self._not_full.notify_all()
+
+    def _run_window(self, drained: Dict[int, List[Entry]]) -> None:
+        self._window_entries = drained  # crash path fails their tickets
+        feeds = build_feeds(drained, self.window.max_rows)
+        self._crash_point("pump_coalesce")
+        k = self.window.max_ticks
+        for i in range(0, len(feeds), k):
+            chunk = feeds[i:i + k]
+            tick0 = self.sched._tick
+            self._crash_point("pump_before_tick")
+            self.sched.tick_many([f.batches for f in chunk],
+                                 feed_ids=[f.ids for f in chunk])
+            self._crash_point("pump_after_tick")
+            applied = 0
+            for j, f in enumerate(chunk):
+                for entries in f.entries.values():
+                    for e in entries:
+                        e.ticket._resolve(TicketResult(
+                            APPLIED, e.batch_id, tick=tick0 + j + 1,
+                            coalesced_with=len(entries) - 1))
+                        applied += 1
+            with self._lock:
+                self.ticks += len(chunk)
+                self.applied += applied
+        with self._lock:
+            self.pump_iterations += 1
+            self.ticks_per_pump.append(len(feeds))
+        self._window_entries = None
+
+    def _on_pump_crash(self, error: BaseException) -> None:
+        with self._lock:
+            self._state = "failed"
+            self.pump_error = error
+            self._executing = False
+            stranded = self._queues.drain_all()
+            self._queues.commit_executing()
+            self._not_full.notify_all()
+            self._work.notify_all()
+            self._idle.notify_all()
+        crash = PumpCrashed(f"ingest pump died: {error!r}")
+        crash.__cause__ = error
+        window = getattr(self, "_window_entries", None) or {}
+        for entries in list(window.values()) + list(stranded.values()):
+            for e in entries:
+                if not e.ticket.done():
+                    e.ticket._fail(crash)

@@ -1,0 +1,146 @@
+"""Admission queues: per-source bounded FIFOs under a global byte budget.
+
+Pure data structure — every method is called with the frontend's lock
+held; no locking happens here. The two admission limits compose:
+
+- ``max_batches`` bounds each SOURCE's queue depth (a slow source can't
+  starve the rest);
+- ``max_bytes`` bounds the TOTAL in-flight payload (queued + currently
+  executing), the memory backstop for "millions of users" traffic.
+
+What happens when a limit is hit is the frontend's backpressure policy
+(``block`` / ``reject`` / ``shed-oldest``); this module only answers
+"is there room" and "which entries would shedding evict".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from reflow_tpu.graph import Node
+
+from .tickets import Ticket
+
+__all__ = ["Entry", "SourceQueues", "batch_nbytes"]
+
+
+def batch_nbytes(batch) -> int:
+    """Payload bytes of a delta batch, duck-typed over the columns so
+    host (numpy) and device (jax) batches both answer without a device
+    sync (``.nbytes`` is metadata on both)."""
+    return sum(int(getattr(col, "nbytes", 0) or 0)
+               for col in (batch.keys, batch.values, batch.weights))
+
+
+@dataclasses.dataclass
+class Entry:
+    """One admitted micro-batch waiting for (or riding) a macro-tick."""
+
+    ticket: Ticket
+    source: Node
+    batch: object                # DeltaBatch or device-resident batch
+    batch_id: str
+    nbytes: int
+    t_admitted: float
+    #: device-resident batches ride a feed slot ALONE (the
+    #: one-per-source-per-tick rule; host concat would force a readback)
+    device: bool
+    #: host row count (0 for device batches — len() would read back)
+    rows: int
+
+
+class SourceQueues:
+    def __init__(self, max_batches: int, max_bytes: int):
+        self.max_batches = max_batches
+        self.max_bytes = max_bytes
+        self._q: Dict[int, Deque[Entry]] = {}
+        self.queued_batches = 0
+        self.queued_rows = 0
+        self.queued_bytes = 0
+        #: bytes drained into an executing macro-tick but not yet
+        #: committed — still counted against the budget
+        self.executing_bytes = 0
+
+    # -- admission ---------------------------------------------------------
+
+    def room_for(self, source_id: int, nbytes: int) -> bool:
+        depth = len(self._q.get(source_id, ()))
+        return (depth < self.max_batches
+                and self.queued_bytes + self.executing_bytes + nbytes
+                <= self.max_bytes)
+
+    def fits_alone(self, nbytes: int) -> bool:
+        """Could this batch EVER be admitted (empty queues)? False means
+        the batch alone exceeds the byte budget — reject, don't shed."""
+        return nbytes <= self.max_bytes
+
+    def push(self, entry: Entry) -> None:
+        self._q.setdefault(entry.source.id, deque()).append(entry)
+        self.queued_batches += 1
+        self.queued_rows += entry.rows
+        self.queued_bytes += entry.nbytes
+
+    def shed_for(self, source_id: int, nbytes: int) -> List[Entry]:
+        """Evict oldest-first until ``room_for`` holds: first from the
+        submitting source's own queue (depth limit), then globally
+        oldest (byte budget). Returns the evicted entries — the caller
+        resolves their tickets as SHED."""
+        out: List[Entry] = []
+        q = self._q.get(source_id)
+        while q and len(q) >= self.max_batches:
+            out.append(self._pop_entry(q))
+        while (self.queued_bytes + self.executing_bytes + nbytes
+               > self.max_bytes):
+            oldest: Optional[Deque[Entry]] = None
+            for dq in self._q.values():
+                if dq and (oldest is None
+                           or dq[0].t_admitted < oldest[0].t_admitted):
+                    oldest = dq
+            if oldest is None:
+                break  # nothing left to shed (executing bytes dominate)
+            out.append(self._pop_entry(oldest))
+        return out
+
+    def _pop_entry(self, dq: Deque[Entry]) -> Entry:
+        e = dq.popleft()
+        self.queued_batches -= 1
+        self.queued_rows -= e.rows
+        self.queued_bytes -= e.nbytes
+        return e
+
+    # -- pump side ---------------------------------------------------------
+
+    def oldest_t(self) -> Optional[float]:
+        ts = [dq[0].t_admitted for dq in self._q.values() if dq]
+        return min(ts) if ts else None
+
+    def pending_feed_rounds(self, max_rows: int) -> int:
+        """How many macro-tick feeds the current backlog would unfold
+        into (the max-ticks coalescing trigger): per source, each
+        device batch needs its own feed slot and host rows pack
+        ``max_rows`` per slot; feeds form in parallel across sources,
+        so the count is the max over sources."""
+        rounds = 0
+        for dq in self._q.values():
+            dev = sum(1 for e in dq if e.device)
+            host_rows = sum(e.rows for e in dq if not e.device)
+            r = dev + (host_rows + max_rows - 1) // max_rows if dq else 0
+            rounds = max(rounds, r)
+        return rounds
+
+    def drain_all(self) -> Dict[int, List[Entry]]:
+        """Take the whole backlog (per-source FIFO order preserved);
+        their bytes move to ``executing_bytes`` until the caller calls
+        :meth:`commit_executing`."""
+        out = {sid: list(dq) for sid, dq in self._q.items() if dq}
+        self.executing_bytes += self.queued_bytes
+        self._q.clear()
+        self.queued_batches = 0
+        self.queued_rows = 0
+        self.queued_bytes = 0
+        return out
+
+    def commit_executing(self) -> None:
+        self.executing_bytes = 0

@@ -24,6 +24,7 @@ run's (``tests/test_wal.py``).
 from __future__ import annotations
 
 import os
+import threading
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -52,7 +53,15 @@ class CrashInjector:
     ``at`` counts every visited seam; ``only`` restricts counting to
     seams whose name contains the substring (e.g. ``"append"`` to die
     inside the WAL write path, ``"after_push"`` to die between push and
-    tick). ``fired`` records whether the kill happened.
+    tick, ``"pump"`` to kill the serve frontend's pump thread).
+    ``fired`` records whether the kill happened.
+
+    Seam visits are counted under a lock: the serve frontend fires its
+    seams from N producer threads (``producer_submit`` /
+    ``producer_admitted``) and the pump thread (``pump_coalesce`` /
+    ``pump_before_tick`` / ``pump_after_tick``) concurrently, and
+    exactly ONE of them must die — a racy double-fire would kill a
+    producer *and* the pump, breaking the single-process-death model.
     """
 
     def __init__(self, at: int, *, only: Optional[str] = None):
@@ -60,15 +69,18 @@ class CrashInjector:
         self.only = only
         self.fired = False
         self.seams: List[str] = []
+        self._lock = threading.Lock()
 
     def point(self, name: str) -> None:
-        if self.fired or (self.only is not None and self.only not in name):
-            return
-        self.seams.append(name)
-        self.remaining -= 1
-        if self.remaining <= 0:
-            self.fired = True
-            raise CrashPoint(name)
+        with self._lock:
+            if self.fired or (self.only is not None
+                              and self.only not in name):
+                return
+            self.seams.append(name)
+            self.remaining -= 1
+            if self.remaining <= 0:
+                self.fired = True
+                raise CrashPoint(name)
 
 
 def tear_wal_tail(wal_dir: str, cut_bytes: int) -> Optional[str]:

@@ -15,8 +15,8 @@ from typing import List, Sequence
 
 import numpy as np
 
-__all__ = ["MetricsSummary", "WalMetrics", "summarize", "summarize_wal",
-           "profile_trace"]
+__all__ = ["MetricsSummary", "ServeMetrics", "WalMetrics", "summarize",
+           "summarize_serve", "summarize_wal", "profile_trace"]
 
 
 @dataclasses.dataclass
@@ -106,6 +106,12 @@ class WalMetrics:
     replayed_pushes: int
     deduped_pushes: int
     replayed_ticks: int
+    #: group-commit shape under ``fsync="record"``: appends covered per
+    #: fsync (1.0 everywhere = no batching happened; the serve frontend's
+    #: coalesced appends should push these well above 1)
+    group_commits: int = 0
+    group_p50: float = 0.0
+    group_max: float = 0.0
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -129,6 +135,64 @@ def summarize_wal(wal, recovery=None) -> WalMetrics:
         replayed_pushes=getattr(recovery, "replayed_pushes", 0),
         deduped_pushes=getattr(recovery, "deduped_pushes", 0),
         replayed_ticks=getattr(recovery, "replayed_ticks", 0),
+        group_commits=len(getattr(wal, "group_sizes", [])),
+        group_p50=pct(getattr(wal, "group_sizes", []), 50),
+        group_max=float(max(getattr(wal, "group_sizes", []) or [0.0])),
+    )
+
+
+@dataclasses.dataclass
+class ServeMetrics:
+    """Ingestion-frontend observability (``reflow_tpu.serve``): admission
+    outcomes, coalescing effectiveness, and producer-visible latency.
+
+    ``coalesce_factor`` is the headline: micro-batches applied per
+    scheduler tick. 1.0 means the window never merged anything (light
+    traffic); the serve bench asserts > 1 under 16 producers.
+    """
+
+    policy: str
+    submitted: int
+    admitted: int
+    applied: int
+    deduped: int
+    rejected: int
+    shed: int
+    ticks: int
+    pump_iterations: int
+    coalesce_factor: float
+    ticks_per_pump_mean: float
+    admission_p50_s: float
+    admission_p95_s: float
+    queue_depth_p95: float
+    inflight_bytes_peak: int
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def summarize_serve(frontend) -> ServeMetrics:
+    """Aggregate an ``IngestFrontend``'s counters into one record."""
+    def pct(xs, q: float) -> float:
+        return float(np.percentile(xs, q)) if len(xs) else 0.0
+
+    tp = frontend.ticks_per_pump
+    return ServeMetrics(
+        policy=frontend.policy,
+        submitted=frontend.submitted,
+        admitted=frontend.admitted,
+        applied=frontend.applied,
+        deduped=frontend.deduped,
+        rejected=frontend.rejected,
+        shed=frontend.shed,
+        ticks=frontend.ticks,
+        pump_iterations=frontend.pump_iterations,
+        coalesce_factor=frontend.applied / max(frontend.ticks, 1),
+        ticks_per_pump_mean=float(np.mean(tp)) if tp else 0.0,
+        admission_p50_s=pct(frontend.admission_s, 50),
+        admission_p95_s=pct(frontend.admission_s, 95),
+        queue_depth_p95=pct(frontend.queue_depth_samples, 95),
+        inflight_bytes_peak=frontend.inflight_bytes_peak,
     )
 
 

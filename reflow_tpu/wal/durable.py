@@ -125,27 +125,65 @@ class DurableScheduler(DirtyScheduler):
             self._log_tick_mark()
         return result
 
-    def tick_many(self, feeds: Sequence[Dict[Node, DeltaBatch]]
-                  ) -> TickResult:
+    def tick_many(self, feeds: Sequence[Dict[Node, DeltaBatch]], *,
+                  feed_ids=None) -> TickResult:
         if self._wal_suspended:
-            return super().tick_many(feeds)
+            return super().tick_many(feeds, feed_ids=feed_ids)
         # feeds bypass push(), so log them here first (append-before-
-        # accept, same as push); auto ids make the replay idempotent.
-        # Device-resident feeds get materialized — a forced sync that
-        # negates the macro-tick's pipelining; durable ingestion wants
-        # host-side feeds.
-        logged = []
-        for feed in feeds:
-            logged.append({
-                src: self._log_push(src, b, self._mint_auto_id(src))
-                for src, b in feed.items()})
-        result = super().tick_many(logged)
+        # accept, same as push). ``feed_ids`` carries the producer batch
+        # ids a coalesced feed entry commits (serve frontend); entries
+        # without ids get an auto id so the replay is still idempotent.
+        # The whole window is one wal.append_group — under
+        # fsync="record" that is ONE fsync for the window (group
+        # commit), not one per micro-batch. Device-resident feeds get
+        # materialized — a forced sync that negates the macro-tick's
+        # pipelining; durable ingestion wants host-side feeds.
+        ids_seq = feed_ids if feed_ids is not None else [{}] * len(feeds)
+        logged, records = [], []
+        for feed, ids_map in zip(feeds, ids_seq):
+            entry = {}
+            for src, b in feed.items():
+                ids = list(ids_map.get(src, ())) or [self._mint_auto_id(src)]
+                if hasattr(b, "nonzero"):  # device-resident: forced readback
+                    b = self.executor.materialize(b)
+                entry[src] = b
+                rec = {
+                    "kind": "push",
+                    "tick": self._tick,
+                    "node": src.id,
+                    "node_name": src.name,
+                    "batch_id": ids[0],
+                    "keys": b.keys,
+                    "values": b.values,
+                    "weights": b.weights,
+                }
+                if len(ids) > 1:
+                    # several micro-batches coalesced into this one feed
+                    # batch: their ids commit (and replay) atomically
+                    rec["batch_ids"] = ids
+                records.append(rec)
+            logged.append(entry)
+        self._crash_point("before_append")
+        self.wal.append_group(records)
+        self._crash_point("after_append")
+        # suspend the per-tick overrides during execution: the fallback
+        # path runs self.tick() per feed, and its per-tick markers would
+        # duplicate the window markers appended below
+        self._wal_suspended = True
+        try:
+            result = super().tick_many(logged, feed_ids=feed_ids)
+        finally:
+            self._wal_suspended = False
         tick_now = self._tick
-        for t in range(tick_now - len(feeds) + 1, tick_now + 1):
-            self.wal.append({"kind": "tick", "tick": t})
+        self.wal.append_group([
+            {"kind": "tick", "tick": t}
+            for t in range(tick_now - len(feeds) + 1, tick_now + 1)])
         self.wal.note_tick()
+        self._crash_point("after_tick")
         return result
 
     def close(self) -> None:
-        """Durably flush and close the log (clean shutdown)."""
+        """Durably flush and seal the log (clean shutdown). Idempotent —
+        the serving frontend's ``close()`` and a caller's own shutdown
+        path may both reach it."""
         self.wal.close()

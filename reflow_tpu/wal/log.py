@@ -23,8 +23,9 @@ Durability contract by fsync policy (``fsync=``):
 - ``"tick"`` (default): flush per append (page cache — survives process
   death), fsync once per tick boundary — a power loss can lose at most
   the current in-flight tick, never a committed one.
-- ``"os"``: flush per append, never fsync — survives process death
-  only; the OS decides when bytes hit disk.
+- ``"os"``: flush per append, no per-record/per-tick fsync — survives
+  process death only; the OS decides when bytes hit disk (segment
+  rotation still fsyncs the sealed file, whatever the policy).
 
 A crashed process may leave a torn final record (partial write). The
 read side (:func:`scan_wal`) tolerates exactly that: a bad frame at the
@@ -40,6 +41,7 @@ import os
 import pickle
 import re
 import struct
+import threading
 import time
 import zlib
 from typing import Dict, Iterable, List, NamedTuple, Optional, Tuple
@@ -96,6 +98,15 @@ class WriteAheadLog:
     Latency accounting (``utils.metrics.summarize_wal``): every append
     and fsync wall is recorded in ``append_s`` / ``fsync_s``, and
     ``appends`` / ``fsyncs`` / ``bytes_written`` count totals.
+
+    Thread safety + group commit (ROADMAP open item): appends are safe
+    from concurrent threads, and under ``fsync="record"`` the fsync is a
+    classic *group commit* — a writer whose frame was already covered by
+    another writer's fsync (or by :meth:`append_group`'s single barrier
+    over a whole coalescing window) skips its own. ``group_sizes``
+    records how many appends each fsync covered; >1 means grouping
+    engaged (the serving frontend's coalescing window is the hot
+    producer of large groups).
     """
 
     POLICIES = ("record", "tick", "os")
@@ -126,6 +137,13 @@ class WriteAheadLog:
         self.bytes_written = 0
         self.append_s: List[float] = []
         self.fsync_s: List[float] = []
+        #: appends covered per fsync (group-commit effectiveness)
+        self.group_sizes: List[int] = []
+        self._lock = threading.RLock()
+        self._unsynced_appends = 0
+        #: (segment, offset) durably synced through — the group-commit
+        #: free-ride check compares a frame's end position against this
+        self._synced_pos = (self._seq, 0)
         self._open_segment()
 
     # -- write side --------------------------------------------------------
@@ -136,10 +154,9 @@ class WriteAheadLog:
         self._f.flush()
         self._offset = len(_MAGIC)
 
-    def append(self, record: Dict) -> LogPosition:
-        """Frame + append one record; returns its position. Honors the
-        ``"record"`` fsync policy; ``"tick"`` batches the fsync into
-        :meth:`note_tick`."""
+    def _write_frame(self, record: Dict) -> Tuple[LogPosition,
+                                                  Tuple[int, int]]:
+        # caller holds self._lock; returns (position, end-of-frame mark)
         t0 = time.perf_counter()
         payload = pickle.dumps(record, protocol=pickle.HIGHEST_PROTOCOL)
         frame = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
@@ -150,41 +167,88 @@ class WriteAheadLog:
         self._f.flush()
         self._offset += len(frame)
         self.appends += 1
+        self._unsynced_appends += 1
         self.bytes_written += len(frame)
-        if self.fsync_policy == "record":
-            self._fsync()
         self.append_s.append(time.perf_counter() - t0)
+        end = (self._seq, self._offset)
         if self._offset >= self.segment_bytes:
             self.rotate()
+        return pos, end
+
+    def append(self, record: Dict) -> LogPosition:
+        """Frame + append one record; returns its position. Honors the
+        ``"record"`` fsync policy (with group commit — see the class
+        docstring); ``"tick"`` batches the fsync into :meth:`note_tick`.
+        """
+        with self._lock:
+            pos, end = self._write_frame(record)
+        if self.fsync_policy == "record":
+            self._record_fsync(end)
         return pos
 
+    def append_group(self, records: Iterable[Dict]) -> List[LogPosition]:
+        """Append several records under ONE durability barrier: the
+        explicit group-commit path for a coalescing window whose batches
+        commit atomically anyway (``DurableScheduler.tick_many``). Under
+        ``"record"`` the group shares a single fsync."""
+        with self._lock:
+            out = [self._write_frame(r) for r in records]
+        if out and self.fsync_policy == "record":
+            self._record_fsync(out[-1][1])
+        return [pos for pos, _end in out]
+
+    def _record_fsync(self, end: Tuple[int, int]) -> None:
+        # group commit: the first writer to reach the lock fsyncs for
+        # every frame written so far; a writer whose frame is already
+        # covered (rotation sealed it, or another writer's fsync passed
+        # it) takes the free ride
+        with self._lock:
+            if self._synced_pos >= end:
+                return
+            self._fsync()
+
     def _fsync(self) -> None:
+        # caller holds self._lock
         t0 = time.perf_counter()
         os.fsync(self._f.fileno())
         self.fsyncs += 1
         self.fsync_s.append(time.perf_counter() - t0)
+        if self._unsynced_appends:
+            self.group_sizes.append(self._unsynced_appends)
+            self._unsynced_appends = 0
+        self._synced_pos = max(self._synced_pos, (self._seq, self._offset))
 
     def note_tick(self) -> None:
         """Tick-boundary durability barrier (``"tick"`` policy fsyncs
         here; ``"record"`` already did; ``"os"`` never does)."""
         if self.fsync_policy == "tick":
-            self._fsync()
+            with self._lock:
+                self._fsync()
 
     def sync(self) -> None:
         """Unconditional durability barrier (checkpoint path)."""
-        self._f.flush()
-        self._fsync()
+        with self._lock:
+            self._f.flush()
+            self._fsync()
 
     def position(self) -> LogPosition:
         """Position one past the last appended byte."""
-        return LogPosition(self._seq, self._offset)
+        with self._lock:
+            return LogPosition(self._seq, self._offset)
 
     def rotate(self) -> None:
-        """Seal the current segment and open the next one."""
-        self._f.flush()
-        self._f.close()
-        self._seq += 1
-        self._open_segment()
+        """Seal the current segment and open the next one. The sealed
+        segment is fsynced before close — whatever the policy, bytes in
+        a sealed segment are durable (so the group-commit free-ride
+        check can trust ``_synced_pos`` across rotations, and a
+        mid-tick rotation can't strand committed records in the page
+        cache)."""
+        with self._lock:
+            self._f.flush()
+            self._fsync()
+            self._f.close()
+            self._seq += 1
+            self._open_segment()
 
     def truncate_until(self, pos: LogPosition) -> List[str]:
         """Delete sealed segments strictly before ``pos.segment`` (the
@@ -197,10 +261,11 @@ class WriteAheadLog:
         return removed
 
     def close(self) -> None:
-        if self._f is not None and not self._f.closed:
-            self._f.flush()
-            self._fsync()
-            self._f.close()
+        with self._lock:
+            if self._f is not None and not self._f.closed:
+                self._f.flush()
+                self._fsync()
+                self._f.close()
 
 
 # -- read side -------------------------------------------------------------

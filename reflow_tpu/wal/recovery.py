@@ -99,11 +99,23 @@ def recover(sched, wal_dir: str, ckpt_dir: Optional[str] = None,
             if kind == "push":
                 batch = DeltaBatch(rec["keys"], rec["values"],
                                    rec["weights"])
-                if sched.push(_resolve_source(sched, rec), batch,
-                              batch_id=rec["batch_id"]):
-                    replayed += 1
-                else:
+                node = _resolve_source(sched, rec)
+                ids = rec.get("batch_ids")
+                if ids is None:
+                    if sched.push(node, batch, batch_id=rec["batch_id"]):
+                        replayed += 1
+                    else:
+                        deduped += 1
+                elif any(b in sched._seen_batch_ids for b in ids):
+                    # a coalesced frontend feed batch: its micro-batch
+                    # ids committed atomically with the macro-tick, so
+                    # the replay is all-or-nothing too
                     deduped += 1
+                else:
+                    for b in ids:
+                        sched._register_batch_id(b)
+                    sched.push(node, batch)
+                    replayed += 1
             elif kind == "tick":
                 if rec["tick"] > sched._tick:
                     sched.tick()

@@ -368,3 +368,90 @@ def test_empty_history_summary_keyword_constructed():
     s = summarize([])
     assert s.ticks == 0 and s.delta_ops == 0
     assert s.quiesced_all is True and s.forced_syncs == 0
+
+
+# -- group commit (fsync="record") ------------------------------------------
+
+def test_append_group_one_fsync_covers_the_group(tmp_path):
+    wal = WriteAheadLog(str(tmp_path), fsync="record")
+    fsyncs0 = wal.fsyncs
+    poss = wal.append_group([{"kind": "tick", "tick": i} for i in range(5)])
+    assert len(poss) == 5
+    assert wal.fsyncs == fsyncs0 + 1
+    assert wal.group_sizes[-1] == 5
+    wal.close()
+    records, torn = scan_wal(str(tmp_path))
+    assert torn is None
+    assert [r["tick"] for _p, r in records] == list(range(5))
+
+
+def test_individual_appends_record_group_size_one(tmp_path):
+    wal = WriteAheadLog(str(tmp_path), fsync="record")
+    for i in range(3):
+        wal.append({"kind": "tick", "tick": i})
+    wal.close()
+    assert wal.group_sizes == [1, 1, 1]
+    assert wal.fsyncs >= 3
+
+
+def test_group_commit_survives_rotation(tmp_path):
+    # a group large enough to rotate mid-group must still land every
+    # record durably and scan back in order
+    wal = WriteAheadLog(str(tmp_path), fsync="record", segment_bytes=256)
+    wal.append_group([{"kind": "tick", "tick": i} for i in range(64)])
+    wal.close()
+    assert len(list_segments(str(tmp_path))) > 1
+    records, torn = scan_wal(str(tmp_path))
+    assert torn is None
+    assert [r["tick"] for _p, r in records] == list(range(64))
+
+
+def test_empty_group_is_a_noop(tmp_path):
+    wal = WriteAheadLog(str(tmp_path), fsync="record")
+    fsyncs0 = wal.fsyncs
+    assert wal.append_group([]) == []
+    assert wal.fsyncs == fsyncs0 and wal.appends == 0
+    wal.close()
+
+
+def test_wal_metrics_report_group_shape(tmp_path):
+    wal = WriteAheadLog(str(tmp_path), fsync="record")
+    wal.append({"kind": "tick", "tick": 0})
+    wal.append_group([{"kind": "tick", "tick": i} for i in range(1, 5)])
+    wal.close()
+    wm = summarize_wal(wal)
+    assert wm.group_commits == len(wal.group_sizes)
+    assert wm.group_max == 4.0
+    assert wm.as_dict()["group_p50"] >= 1.0
+
+
+def test_coalesced_batch_ids_replay_all_or_nothing(tmp_path):
+    """A frontend-coalesced push record carries the merged micro-batch
+    ids; its macro-tick committed them atomically, so replay must fold
+    the merged batch once if NO id is known, and never if ANY is."""
+    g, src, sink = wordcount.build_graph()
+    sched = DurableScheduler(g, wal_dir=str(tmp_path / "wal"))
+    sched.tick_many(
+        [{src: wordcount.ingest_lines(["a b"])},
+         {src: wordcount.ingest_lines(["b c"])}],
+        feed_ids=[{src: ["m0", "m1"]}, {src: ["m2"]}])
+    want = dict(sched.view(sink.name))
+    sched.close()
+
+    g2, src2, sink2 = wordcount.build_graph()
+    fresh = DurableScheduler(g2, wal_dir=str(tmp_path / "wal"))
+    report = recover(fresh, str(tmp_path / "wal"))
+    fresh.close()
+    assert dict(fresh.view(sink2.name)) == want
+    assert report.replayed_pushes == 2
+    # all three micro-ids are back in the dedup window after replay
+    for bid in ("m0", "m1", "m2"):
+        assert bid in fresh._seen_batch_ids
+
+    g3, src3, sink3 = wordcount.build_graph()
+    again = DurableScheduler(g3, wal_dir=str(tmp_path / "wal"))
+    # pre-seed ONE of the merged ids: the whole record must dedup
+    again._register_batch_id("m1")
+    report2 = recover(again, str(tmp_path / "wal"))
+    again.close()
+    assert report2.deduped_pushes >= 1

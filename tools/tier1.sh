@@ -6,10 +6,37 @@
 # pytest's status.
 set -o pipefail
 cd "$(dirname "$0")/.."
+
+# fast-fail static pass BEFORE the 15-minute pytest budget: a syntax
+# error or obvious undefined name should cost seconds, not a timeout.
+# pyflakes is optional in the image; compileall is stdlib.
+python -m compileall -q reflow_tpu tests tools bench.py bench_configs.py \
+  || { echo "TIER1: compileall failed"; exit 2; }
+if python -c "import pyflakes" 2>/dev/null; then
+  python -m pyflakes reflow_tpu bench.py bench_configs.py \
+    || { echo "TIER1: pyflakes failed"; exit 2; }
+fi
+
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
   2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+
+# optional (RUN_BENCH=1): the serve-mode smoke — sustained ingestion
+# throughput must coalesce (>1 micro-batch/tick at 16 producers) with
+# zero forced syncs; ~seconds on CPU at smoke scale.
+if [ "${RUN_BENCH:-0}" = "1" ] && [ $rc -eq 0 ]; then
+  REFLOW_BENCH_SERVE=1 REFLOW_BENCH_SMOKE=1 JAX_PLATFORMS=cpu \
+    timeout -k 10 300 python bench.py > /tmp/_t1_serve.json || rc=3
+  python - <<'EOF' || rc=3
+import json
+r = json.load(open("/tmp/_t1_serve.json"))
+assert r["coalesce_gt_1_at_16p"], r
+assert r["zero_forced_syncs"], r
+print(f"TIER1 serve smoke: {r['serve_16p_rows_per_s']} rows/s @16p, "
+      f"coalesce {r['serve_16p_coalesce_factor']}x")
+EOF
+fi
 exit $rc
