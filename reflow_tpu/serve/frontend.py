@@ -39,7 +39,8 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional
+from collections import deque
+from typing import Deque, Dict, List, Optional
 
 from reflow_tpu.graph import GraphError, Node
 from reflow_tpu.scheduler import SourceCursor
@@ -52,6 +53,10 @@ from .tickets import (APPLIED, DEDUPED, REJECTED, SHED, FrontendClosed,
 __all__ = ["IngestFrontend"]
 
 POLICIES = ("block", "reject", "shed-oldest")
+
+#: per-sample metric retention: percentile summaries only need a recent
+#: window, and a long-running serving process must not grow them forever
+METRIC_WINDOW = 4096
 
 
 class IngestFrontend:
@@ -100,9 +105,11 @@ class IngestFrontend:
         self.shed = 0
         self.ticks = 0
         self.pump_iterations = 0
-        self.queue_depth_samples: List[int] = []
-        self.admission_s: List[float] = []
-        self.ticks_per_pump: List[int] = []
+        # bounded reservoirs (most recent METRIC_WINDOW samples) — the
+        # totals above are exact; only percentile inputs are windowed
+        self.queue_depth_samples: Deque[int] = deque(maxlen=METRIC_WINDOW)
+        self.admission_s: Deque[float] = deque(maxlen=METRIC_WINDOW)
+        self.ticks_per_pump: Deque[int] = deque(maxlen=METRIC_WINDOW)
         self.inflight_bytes_peak = 0
         self._thread = threading.Thread(
             target=self._pump_loop, name="reflow-ingest-pump", daemon=True)
@@ -155,6 +162,16 @@ class IngestFrontend:
             nbytes = batch_nbytes(batch)
             if not self._admit(source, nbytes, ticket, batch_id, deadline):
                 return ticket  # ticket already resolved REJECTED/…
+            if batch_id in self._admitted:
+                # a blocked admission drops the lock in wait(): another
+                # producer may have admitted this very id meanwhile —
+                # pushing now would fold the batch twice
+                self.deduped += 1
+                ticket._resolve(TicketResult(
+                    DEDUPED, batch_id,
+                    reason="batch_id admitted concurrently while this "
+                           "submit was blocked on backpressure"))
+                return ticket
             entry = Entry(ticket, source, batch, batch_id, nbytes,
                           time.perf_counter(), device, rows)
             self._note_admitted(batch_id)
@@ -189,6 +206,10 @@ class IngestFrontend:
                     return False
                 for e in self._queues.shed_for(source.id, nbytes):
                     self.shed += 1
+                    # the evicted batch never reached the scheduler: drop
+                    # it from the dedup mirror so the re-send the SHED
+                    # ticket demands is admitted, not DEDUPED away
+                    self._admitted.pop(e.batch_id, None)
                     e.ticket._resolve(TicketResult(
                         SHED, e.batch_id,
                         reason="shed-oldest backpressure; re-send"))
@@ -303,13 +324,24 @@ class IngestFrontend:
             if self._state in ("closed", "failed"):
                 self._seal()
                 return
-            self._closing_flush = flush and self._state == "running"
+            if self._state == "running":
+                self._closing_flush = flush
+            # else: a retry after a close() timeout — keep the original
+            # call's flush intent rather than silently downgrading it
             self._state = "closing"
             self._paused = False
             self._not_full.notify_all()
             self._work.notify_all()
         if self._thread.is_alive():
             self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                # the pump is still mid-macro-tick: sealing the WAL now
+                # would close a file it is appending to. Stay "closing"
+                # (admission already refused) and let the caller retry.
+                raise TimeoutError(
+                    f"close() timed out after {timeout}s with the pump "
+                    f"still draining; frontend left in state 'closing' "
+                    f"— call close() again to finish")
         with self._lock:
             if self._state != "failed":
                 self._state = "closed"

@@ -44,7 +44,9 @@ import struct
 import threading
 import time
 import zlib
-from typing import Dict, Iterable, List, NamedTuple, Optional, Tuple
+from collections import deque
+from typing import (Deque, Dict, Iterable, List, NamedTuple, Optional,
+                    Tuple)
 
 __all__ = ["LogPosition", "TornTail", "WalError", "WriteAheadLog",
            "list_segments", "scan_wal"]
@@ -55,6 +57,9 @@ _SEG_RE = re.compile(r"^wal-(\d{8})\.log$")
 #: frame-length sanity bound — a "length" beyond this is a torn/corrupt
 #: header, not a real record (segments rotate long before this)
 _MAX_RECORD = 1 << 30
+#: latency/group-size sample retention (percentile inputs only — the
+#: ``appends``/``fsyncs``/``bytes_written`` counters stay exact)
+_METRIC_WINDOW = 4096
 
 
 class WalError(RuntimeError):
@@ -135,10 +140,13 @@ class WriteAheadLog:
         self.appends = 0
         self.fsyncs = 0
         self.bytes_written = 0
-        self.append_s: List[float] = []
-        self.fsync_s: List[float] = []
+        # bounded reservoirs (most recent _METRIC_WINDOW samples): the
+        # counters above are exact; only percentile inputs are windowed,
+        # so a long-running server's log can't leak through its metrics
+        self.append_s: Deque[float] = deque(maxlen=_METRIC_WINDOW)
+        self.fsync_s: Deque[float] = deque(maxlen=_METRIC_WINDOW)
         #: appends covered per fsync (group-commit effectiveness)
-        self.group_sizes: List[int] = []
+        self.group_sizes: Deque[int] = deque(maxlen=_METRIC_WINDOW)
         self._lock = threading.RLock()
         self._unsynced_appends = 0
         #: (segment, offset) durably synced through — the group-commit

@@ -227,6 +227,55 @@ def test_shed_oldest_policy_evicts_and_reports():
     assert dict(sched.view(sink.name)) == {("b", 1.0): 1, ("c", 1.0): 1}
 
 
+def test_shed_batch_resent_with_same_id_is_admitted():
+    # the SHED contract: the ticket tells the upstream to re-send, so a
+    # re-send with the SAME batch_id must be admitted (the batch never
+    # reached the scheduler), not swallowed as DEDUPED
+    fe, sched, src, sink = make_frontend(policy="shed-oldest",
+                                         queue_batches=2)
+    fe.pause()
+    t1 = fe.submit(src, lines_batch("a"), batch_id="r0")
+    fe.submit(src, lines_batch("b"), batch_id="r1")
+    fe.submit(src, lines_batch("c"), batch_id="r2")
+    assert t1.result(timeout=5).status == SHED
+    fe.resume()
+    fe.flush()
+    r = fe.submit(src, lines_batch("a"), batch_id="r0").result(timeout=5)
+    assert r.status == APPLIED
+    fe.flush()
+    fe.close()
+    assert dict(sched.view(sink.name)) == {
+        ("a", 1.0): 1, ("b", 1.0): 1, ("c", 1.0): 1}
+
+
+def test_blocked_duplicate_submits_fold_exactly_once():
+    # two producers race the same batch_id through a full queue under
+    # the block policy: the admission wait drops the lock, so the loser
+    # must re-check dedup on wakeup — exactly one APPLIED, one DEDUPED
+    fe, sched, src, sink = make_frontend(policy="block", queue_batches=1)
+    fe.pause()
+    fe.submit(src, lines_batch("x"), batch_id="seed")   # fills the queue
+    results = []
+
+    def dup_producer():
+        results.append(
+            fe.submit(src, lines_batch("d"), batch_id="dup").result(
+                timeout=10))
+
+    threads = [threading.Thread(target=dup_producer) for _ in range(2)]
+    for th in threads:
+        th.start()
+    import time
+    time.sleep(0.05)               # both reach the admission wait
+    fe.resume()
+    for th in threads:
+        th.join(timeout=10)
+    fe.flush()
+    fe.close()
+    assert sorted(r.status for r in results) == [APPLIED, DEDUPED]
+    assert dict(sched.view(sink.name)) == {("x", 1.0): 1, ("d", 1.0): 1}
+
+
 def test_oversized_batch_rejected_not_shed():
     fe, _sched, src, _sink = make_frontend(policy="shed-oldest",
                                            max_bytes=8)
@@ -281,6 +330,34 @@ def test_close_without_flush_fails_queued_tickets():
     with pytest.raises(FrontendClosed):
         t.result(timeout=5)
     assert dict(sched.view(sink.name)) == {}
+
+
+def test_close_timeout_does_not_seal_while_pump_drains():
+    # a close() whose join times out mid-macro-tick must NOT report
+    # closed / seal the scheduler's WAL while the pump can still append
+    fe, sched, src, _sink = make_frontend()
+    sealed = []
+    sched.close = lambda: sealed.append(1)
+    entered, release = threading.Event(), threading.Event()
+    orig = sched.tick_many
+
+    def slow_tick_many(*a, **kw):
+        entered.set()
+        release.wait(10)
+        return orig(*a, **kw)
+
+    sched.tick_many = slow_tick_many
+    t = fe.submit(src, lines_batch("a"))
+    assert entered.wait(5)          # pump is mid-macro-tick
+    with pytest.raises(TimeoutError):
+        fe.close(timeout=0.05)
+    assert not sealed               # WAL-seal must not have run
+    with pytest.raises(FrontendClosed):
+        fe.submit(src, lines_batch("b"))   # admission already refused
+    release.set()
+    fe.close()                      # retry finishes the shutdown
+    assert sealed
+    assert t.result(timeout=5).applied
 
 
 def test_close_is_idempotent():

@@ -390,7 +390,7 @@ def test_individual_appends_record_group_size_one(tmp_path):
     for i in range(3):
         wal.append({"kind": "tick", "tick": i})
     wal.close()
-    assert wal.group_sizes == [1, 1, 1]
+    assert list(wal.group_sizes) == [1, 1, 1]
     assert wal.fsyncs >= 3
 
 
