@@ -58,6 +58,13 @@ Env knobs::
                                   coalesce factor, zero forced syncs
                                   (CPU-only, no tunnel)
     REFLOW_BENCH_SERVE_BATCHES    micro-batches per producer (default 250)
+    REFLOW_BENCH_TIER=1           tier mode instead: ServeTier hosting 4
+                                  graphs x 4 producers on a 2-thread pump
+                                  pool vs 4 independent frontends, plus
+                                  pump-crash isolation (exactly-once after
+                                  recover) and hot/quiet-tenant QoS
+                                  isolation (CPU-only, no tunnel)
+    REFLOW_BENCH_TIER_BATCHES     micro-batches per producer (default 200)
 """
 
 from __future__ import annotations
@@ -319,6 +326,255 @@ def run_serve_bench() -> dict:
     out["coalesce_gt_1_at_16p"] = out["serve_16p_coalesce_factor"] > 1.0
     out["zero_forced_syncs"] = all(
         out[f"serve_{n}p_forced_syncs"] == 0 for n in (1, 4, 16))
+    return out
+
+
+# -- tier / multi-graph serving mode (REFLOW_BENCH_TIER=1) -----------------
+
+def run_tier_bench() -> dict:
+    """Multi-graph serving-tier numbers (docs/guide.md "Serving tier"),
+    three phases:
+
+    A. **throughput** — 4 graphs x 4 producers each on a 2-thread
+       ``ServeTier`` pump pool vs the same load on 4 independent
+       ``IngestFrontend``\\ s (4 private pump threads), asserting zero
+       forced syncs on every scheduler (the pool only ever calls
+       ``tick_many``);
+    B. **crash isolation** — a ``pool_window@<name>`` kill on one
+       durable graph: its undecided tickets fail ``PumpCrashed``,
+       siblings keep applying on the surviving pool, and WAL
+       ``recover()`` + same-id re-send lands exactly-once;
+    C. **QoS isolation** — a hot tenant saturating its budget ceiling
+       next to a quiet tenant with a byte floor: the quiet tenant's
+       admission p99 must stay bounded.
+
+    Host-side CPU work (no tunnel protocol applies).
+    """
+    import tempfile
+    import threading
+
+    from reflow_tpu.scheduler import DirtyScheduler
+    from reflow_tpu.serve import (CoalesceWindow, GraphConfig,
+                                  IngestFrontend, PumpCrashed, ServeTier)
+    from reflow_tpu.utils.faults import CrashInjector
+    from reflow_tpu.utils.metrics import summarize, summarize_tier
+    from reflow_tpu.wal import DurableScheduler, recover
+    from reflow_tpu.workloads import wordcount
+
+    smoke = os.environ.get("REFLOW_BENCH_SMOKE") == "1"
+    per_producer = int(os.environ.get(
+        "REFLOW_BENCH_TIER_BATCHES", "30" if smoke else "200"))
+    rows_per_batch = 8
+    n_graphs = n_prod = 4
+    window = CoalesceWindow(max_rows=4096, max_ticks=8,
+                            max_latency_s=0.005)
+
+    def make_lines(graph: int, producer: int, j: int) -> list:
+        rng = np.random.default_rng(
+            (graph * 101 + producer) * 100_003 + j)
+        return [" ".join(f"w{int(x)}"
+                         for x in rng.integers(0, 1000, rows_per_batch))]
+
+    out = {"graphs": n_graphs, "producers_per_graph": n_prod,
+           "per_producer_batches": per_producer,
+           "rows_per_batch": rows_per_batch}
+    n_batches = n_graphs * n_prod * per_producer
+
+    def drive(submit_targets):
+        # submit_targets: list of (submitfn, src) per graph; returns wall
+        tickets, tk_lock = [], threading.Lock()
+
+        def produce(gi, pid, submitfn, src):
+            mine = [submitfn(src, wordcount.ingest_lines(
+                make_lines(gi, pid, j))) for j in range(per_producer)]
+            with tk_lock:
+                tickets.extend(mine)
+
+        threads = [threading.Thread(target=produce,
+                                    args=(gi, pid, fn, src))
+                   for gi, (fn, src) in enumerate(submit_targets)
+                   for pid in range(n_prod)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return tickets, t0
+
+    # -- phase A: tier (2 pump threads) vs 4 independent frontends --------
+    tier = ServeTier(max_bytes=64 << 20, pump_threads=2)
+    scheds, targets, handles = [], [], []
+    for gi in range(n_graphs):
+        g, src, _sink = wordcount.build_graph()
+        sched = DirtyScheduler(g)
+        h = tier.register(f"g{gi}", sched, GraphConfig(window=window))
+        scheds.append(sched)
+        targets.append((h.submit, src))
+        handles.append(h)
+    tickets, t0 = drive(targets)
+    for h in handles:
+        h.flush()
+    tier_wall = time.perf_counter() - t0
+    assert all(t.result(timeout=30).applied for t in tickets)
+    tm = summarize_tier(tier)
+    forced = sum(summarize(s.history).forced_syncs for s in scheds)
+    tier.close()
+    tier_rate = n_batches * rows_per_batch / tier_wall
+    out["tier_rows_per_s_4g_2threads"] = round(tier_rate)
+    out["tier_pump_utilization"] = round(tm.pump_utilization, 3)
+    out["tier_windows"] = tm.windows
+    out["tier_sched_delay_p99_us"] = round(tm.sched_delay_p99_s * 1e6, 1)
+    out["tier_budget_occupancy_peak"] = round(tm.budget_occupancy_peak, 4)
+    out["tier_forced_syncs"] = forced
+    log(f"tier[4g x 4p, 2 threads]: {n_batches} batches in "
+        f"{tier_wall:.3f}s ({tier_rate:.0f} rows/s, util "
+        f"{tm.pump_utilization:.2f}, forced_syncs={forced})")
+
+    scheds, targets, fes = [], [], []
+    for gi in range(n_graphs):
+        g, src, _sink = wordcount.build_graph()
+        sched = DirtyScheduler(g)
+        fe = IngestFrontend(sched, window=window, max_bytes=16 << 20)
+        scheds.append(sched)
+        targets.append((fe.submit, src))
+        fes.append(fe)
+    tickets, t0 = drive(targets)
+    for fe in fes:
+        fe.flush()
+    indep_wall = time.perf_counter() - t0
+    assert all(t.result(timeout=30).applied for t in tickets)
+    forced_i = sum(summarize(s.history).forced_syncs for s in scheds)
+    for fe in fes:
+        fe.close()
+    indep_rate = n_batches * rows_per_batch / indep_wall
+    out["indep_rows_per_s_4g_4threads"] = round(indep_rate)
+    out["tier_vs_indep_x"] = round(tier_rate / indep_rate, 3)
+    out["indep_forced_syncs"] = forced_i
+    out["zero_forced_syncs"] = forced + forced_i == 0
+    log(f"indep[4 frontends, 4 threads]: {indep_wall:.3f}s "
+        f"({indep_rate:.0f} rows/s); tier/indep = "
+        f"{out['tier_vs_indep_x']}x")
+
+    # -- phase B: pump-crash on one durable graph; siblings + recovery ----
+    with tempfile.TemporaryDirectory() as tmp:
+        crash = CrashInjector(at=3, only="pump_before_tick@crashy")
+        tier = ServeTier(max_bytes=64 << 20, pump_threads=2, crash=crash)
+        g, src, sink = wordcount.build_graph()
+        dsched = DurableScheduler(g, wal_dir=tmp, fsync="record")
+        hc = tier.register("crashy", dsched, GraphConfig(window=window))
+        g2, src2, sink2 = wordcount.build_graph()
+        ok_sched = DirtyScheduler(g2)
+        hok = tier.register("ok", ok_sched, GraphConfig(window=window))
+
+        n_crash_batches = 40
+        sent = [(f"c{j}", wordcount.ingest_lines(make_lines(9, 0, j)))
+                for j in range(n_crash_batches)]
+        crashy_tk = []
+        for bid, batch in sent:
+            try:
+                crashy_tk.append(hc.submit(src, batch, batch_id=bid))
+            except Exception:  # FrontendClosed once the crash lands
+                break
+            time.sleep(0.0005)  # several windows, not one giant one
+        ok_before = hok.submit(src2, wordcount.ingest_lines(
+            make_lines(8, 0, 0))).result(10)
+        assert ok_before.applied
+        statuses = {"applied": 0, "crashed": 0}
+        for t in crashy_tk:
+            try:
+                t.result(timeout=10)
+                statuses["applied"] += 1
+            except PumpCrashed:
+                statuses["crashed"] += 1
+        assert crash.fired and statuses["crashed"] > 0, statuses
+        assert tier.pool_crashes == 1
+        # the pool survived: the sibling keeps applying AFTER the crash
+        ok_after = hok.submit(src2, wordcount.ingest_lines(
+            make_lines(8, 0, 1))).result(10)
+        assert ok_after.applied
+        tier.unregister("crashy", flush=False)
+        tier.close()
+        out["crash_applied_before"] = statuses["applied"]
+        out["crash_failed_tickets"] = statuses["crashed"]
+
+        # recover the WAL and re-send EVERY id: exactly-once means the
+        # union lands once — replayed-or-reapplied, never doubled
+        g3, src3, sink3 = wordcount.build_graph()
+        rsched = DurableScheduler(g3, wal_dir=tmp, fsync="record")
+        recover(rsched, tmp)
+        fe = IngestFrontend(rsched, window=window)
+        results = [fe.submit(src3, batch, batch_id=bid).result(10)
+                   for bid, batch in sent]
+        fe.flush()
+        fe.close()
+        deduped = sum(r.status == "deduped" for r in results)
+        g4, src4, sink4 = wordcount.build_graph()
+        want = DirtyScheduler(g4)
+        for _bid, batch in sent:
+            want.push(src4, batch)
+            want.tick()
+        assert dict(rsched.view(sink3.name)) == dict(want.view(sink4.name))
+        out["crash_recover_deduped"] = deduped
+        out["crash_exactly_once"] = True
+        log(f"crash[@crashy]: {statuses['applied']} applied, "
+            f"{statuses['crashed']} failed PumpCrashed; sibling ok "
+            f"before+after; recover+resend exactly-once "
+            f"({deduped} deduped)")
+
+    # -- phase C: hot tenant vs quiet tenant isolation --------------------
+    # budget sized so the hot tenant genuinely hits its byte ceiling
+    # (wordcount micro-batches are tiny): saturation has to be real for
+    # the quiet-tenant p99 bound to mean anything
+    budget = 8 << 10
+    tier = ServeTier(max_bytes=budget, pump_threads=2)
+    g, src, sink = wordcount.build_graph()
+    hot = tier.register("hot", DirtyScheduler(g), GraphConfig(
+        weight=1.0, ceiling_bytes=budget // 2, window=window))
+    g2, src2, sink2 = wordcount.build_graph()
+    quiet = tier.register("quiet", DirtyScheduler(g2), GraphConfig(
+        weight=4.0, floor_bytes=budget // 4, window=window))
+    stop = threading.Event()
+
+    def hammer(pid):
+        # fire-and-forget: never waits on tickets, so the hot tenant
+        # queues until ADMISSION (its byte ceiling) is what stops it —
+        # real saturation, the scenario the quiet tenant must survive
+        j = 0
+        while not stop.is_set():
+            hot.submit(src, wordcount.ingest_lines(
+                make_lines(7, pid, j)), timeout=0.2)
+            j += 1
+
+    hammers = [threading.Thread(target=hammer, args=(pid,))
+               for pid in range(3)]
+    for t in hammers:
+        t.start()
+    quiet_n = 60 if smoke else 200
+    t0 = time.perf_counter()
+    applied0 = hot.frontend.applied
+    for j in range(quiet_n):
+        quiet.submit(src2, wordcount.ingest_lines(
+            make_lines(6, 0, j))).result(timeout=30)
+    hot_elapsed = time.perf_counter() - t0
+    hot_applied = hot.frontend.applied - applied0
+    stop.set()
+    for t in hammers:
+        t.join()
+    quiet.flush()
+    hot.flush()
+    p99 = (float(np.percentile(quiet.frontend.admission_s, 99))
+           if quiet.frontend.admission_s else 0.0)
+    tm = summarize_tier(tier)
+    tier.close()
+    out["hot_rows_per_s"] = round(
+        hot_applied * rows_per_batch / hot_elapsed)
+    out["quiet_admission_p99_us"] = round(p99 * 1e6, 1)
+    out["quiet_p99_bounded"] = p99 < 0.05
+    out["hot_budget_peak_frac"] = round(
+        tm.per_graph["hot"]["bytes_peak"] / budget, 3)
+    log(f"isolation: hot {out['hot_rows_per_s']} rows/s (peak "
+        f"{out['hot_budget_peak_frac']} of budget), quiet admission "
+        f"p99 {p99 * 1e6:.0f}us (bounded={out['quiet_p99_bounded']})")
     return out
 
 
@@ -622,6 +878,18 @@ def _spawn(name: str) -> dict:
 
 
 def main() -> None:
+    if os.environ.get("REFLOW_BENCH_TIER") == "1":
+        # tier mode is host-side CPU work — no tunnel, no subprocesses
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        out = run_tier_bench()
+        print(json.dumps({
+            "metric": "tier_rows_per_s_4g_2threads",
+            "value": out["tier_rows_per_s_4g_2threads"],
+            "unit": "rows/s",
+            **out,
+        }))
+        return
+
     if os.environ.get("REFLOW_BENCH_SERVE") == "1":
         # serve mode is host-side CPU work — no tunnel, no subprocesses
         os.environ.setdefault("JAX_PLATFORMS", "cpu")

@@ -563,6 +563,13 @@ class DirtyScheduler:
             f"ticks (deferred residue not converging, or the loop region "
             f"is genuinely divergent)")
 
+    def close(self) -> None:
+        """Release durable resources. A no-op here — the in-memory
+        scheduler holds none — but part of the scheduler surface so
+        lifecycle code (``IngestFrontend.close``, ``ServeTier``) can
+        shut any scheduler down uniformly; ``DurableScheduler``
+        overrides it to seal its WAL."""
+
     # -- host boundary out -------------------------------------------------
 
     def _note_forced_sync(self, context: str) -> None:

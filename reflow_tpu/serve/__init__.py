@@ -1,21 +1,28 @@
-"""Streaming ingestion frontend: concurrent producers → macro-ticks.
+"""Streaming serving: concurrent producers → macro-ticks, one graph or
+many.
 
-``IngestFrontend`` owns a scheduler on a dedicated pump thread and
+``IngestFrontend`` owns one scheduler on a dedicated pump thread and
 exposes a thread-safe ``submit() -> Ticket`` to any number of
 producers, with backpressure, micro-batch coalescing, exactly-once
-admission, and graceful drain/close. See ``docs/guide.md`` ("Serving
-ingestion") for the tour.
+admission, and graceful drain/close. ``ServeTier`` hosts many named
+graphs behind one shared ``AdmissionBudget`` (per-graph floors and
+ceilings) and one pump pool with deficit-weighted round-robin QoS.
+See ``docs/guide.md`` ("Serving ingestion" and "Serving tier") for the
+tour.
 """
 
+from .budget import AdmissionBudget, BudgetShare
 from .coalesce import CoalesceWindow, Feed, build_feeds
 from .frontend import IngestFrontend
 from .queues import batch_nbytes
 from .tickets import (APPLIED, DEDUPED, REJECTED, SHED, FrontendClosed,
                       PumpCrashed, Ticket, TicketResult)
+from .tier import GraphConfig, GraphHandle, ServeTier, dwrr_pick
 
 __all__ = [
     "APPLIED", "DEDUPED", "REJECTED", "SHED",
-    "CoalesceWindow", "Feed", "FrontendClosed", "IngestFrontend",
-    "PumpCrashed", "Ticket", "TicketResult", "batch_nbytes",
-    "build_feeds",
+    "AdmissionBudget", "BudgetShare", "CoalesceWindow", "Feed",
+    "FrontendClosed", "GraphConfig", "GraphHandle", "IngestFrontend",
+    "PumpCrashed", "ServeTier", "Ticket", "TicketResult",
+    "batch_nbytes", "build_feeds", "dwrr_pick",
 ]

@@ -3,10 +3,10 @@ scheduler.
 
 The paper's tick-synchronous model assumes *someone* feeds the
 scheduler; this is that someone. N concurrent producers call
-``submit(source, batch)`` from their own threads; a single **pump
-thread** owns the scheduler (``DirtyScheduler`` or ``DurableScheduler``
-— never touch it directly while the frontend is running), coalesces the
-queued micro-batches into ``tick_many`` macro-ticks, and resolves each
+``submit(source, batch)`` from their own threads; a single **pump**
+owns the scheduler (``DirtyScheduler`` or ``DurableScheduler`` — never
+touch it directly while the frontend is running), coalesces the queued
+micro-batches into ``tick_many`` macro-ticks, and resolves each
 submission's :class:`~reflow_tpu.serve.tickets.Ticket`.
 
 Admission control (per submit, in order):
@@ -15,12 +15,24 @@ Admission control (per submit, in order):
    ``SourceCursor`` (restart-safe: cursors resume past the scheduler's
    recovered dedup window); a duplicate id resolves the ticket
    ``DEDUPED`` immediately, never silently dropped.
-2. **backpressure** — per-source queue depth + global in-flight byte
-   budget, with the configured policy: ``block`` (wait for room; a
-   ``close()`` releases blocked producers with :class:`FrontendClosed`),
-   ``reject`` (resolve ``REJECTED`` now), ``shed-oldest`` (evict the
-   oldest admitted entries — their tickets resolve ``SHED`` — to admit
-   the newer one).
+2. **backpressure** — per-source queue depth + the in-flight byte
+   budget (a :class:`~reflow_tpu.serve.budget.BudgetShare`), with the
+   configured policy: ``block`` (wait for room; a ``close()`` releases
+   blocked producers with :class:`FrontendClosed`), ``reject`` (resolve
+   ``REJECTED`` now), ``shed-oldest`` (evict the oldest admitted
+   entries — their tickets resolve ``SHED`` — to admit the newer one).
+
+Two pump deployments share all of the above (the refactor the serving
+tier forced — admission and pumping are **injectable**):
+
+- ``start=True`` (default): the frontend owns a private pump thread —
+  the PR-2 standalone shape.
+- ``start=False`` + ``lock=``/``work=``/``budget=``: an external pump
+  pool (``serve.tier.ServeTier``) drives the frontend through
+  ``_poll`` / ``_take_window`` / ``_run_window`` / ``_finish_window``,
+  under a lock shared with sibling graphs. The ``_executing`` flag is
+  the per-graph in-flight latch: a graph's macro-tick never interleaves
+  with itself, whoever pumps it.
 
 Steady-state traffic rides the fused streaming path: the pump calls
 ``tick_many`` (never a synchronous ``tick``), so on a device executor
@@ -29,10 +41,12 @@ no mid-stream forced syncs happen — the zero-``forced_syncs`` property
 
 Crash seams (``utils.faults.CrashInjector``): ``producer_submit`` /
 ``producer_admitted`` on the submitting thread, ``pump_coalesce`` /
-``pump_before_tick`` / ``pump_after_tick`` on the pump. A pump kill
-fails every undecided ticket with :class:`PumpCrashed` and releases
-blocked producers; a durable scheduler's WAL then carries exactly-once
-across ``recover()`` + upstream re-send.
+``pump_before_tick`` / ``pump_after_tick`` on the pump. A named
+frontend (tier-managed) scopes its seams as ``<seam>@<name>`` so one
+graph of a pool can be killed in isolation. A pump kill fails every
+undecided ticket with :class:`PumpCrashed` and releases blocked
+producers; a durable scheduler's WAL then carries exactly-once across
+``recover()`` + upstream re-send.
 """
 
 from __future__ import annotations
@@ -45,6 +59,7 @@ from typing import Deque, Dict, List, Optional
 from reflow_tpu.graph import GraphError, Node
 from reflow_tpu.scheduler import SourceCursor
 
+from .budget import AdmissionBudget
 from .coalesce import CoalesceWindow, build_feeds
 from .queues import Entry, SourceQueues, batch_nbytes
 from .tickets import (APPLIED, DEDUPED, REJECTED, SHED, FrontendClosed,
@@ -64,26 +79,42 @@ class IngestFrontend:
 
     ``policy``: backpressure policy (``block`` / ``reject`` /
     ``shed-oldest``). ``queue_batches``: per-source queue bound.
-    ``max_bytes``: global in-flight payload budget. ``window``: the
-    coalescing window (rows / ticks / latency triggers). ``crash``: a
-    ``CrashInjector`` wired to the documented seams (tests only).
+    ``max_bytes``: in-flight payload budget (ignored when ``budget`` is
+    injected). ``window``: the coalescing window (rows / ticks /
+    latency triggers). ``crash``: a ``CrashInjector`` wired to the
+    documented seams (tests only).
+
+    Tier injection (``serve.tier`` wires these; standalone callers
+    leave them defaulted): ``budget`` — a ``BudgetShare`` of a shared
+    ``AdmissionBudget``; ``lock`` — the lock every sibling frontend and
+    the pump pool share; ``work`` — the pool's shared work condition
+    (must be built on ``lock``); ``name`` — the graph name, used to
+    scope crash seams; ``start=False`` — no private pump thread, the
+    pool pumps.
     """
 
     def __init__(self, sched, *, policy: str = "block",
                  queue_batches: int = 256, max_bytes: int = 64 << 20,
                  window: Optional[CoalesceWindow] = None, crash=None,
-                 start: bool = True):
+                 start: bool = True, budget=None, lock=None, work=None,
+                 name: Optional[str] = None):
         if policy not in POLICIES:
             raise ValueError(f"policy {policy!r} not in {POLICIES}")
         self.sched = sched
         self.policy = policy
         self.window = window if window is not None else CoalesceWindow()
+        self.name = name
         self._crash = crash
-        self._lock = threading.Lock()
+        self._lock = lock if lock is not None else threading.Lock()
         self._not_full = threading.Condition(self._lock)   # producers
-        self._work = threading.Condition(self._lock)       # pump
+        self._work = (work if work is not None
+                      else threading.Condition(self._lock))  # pump
         self._idle = threading.Condition(self._lock)       # flush/pause
-        self._queues = SourceQueues(queue_batches, max_bytes)
+        if budget is None:
+            budget = AdmissionBudget(max_bytes).register(name or "frontend")
+        budget.attach(self._not_full)
+        self._budget = budget
+        self._queues = SourceQueues(queue_batches, budget)
         self._cursors: Dict[int, SourceCursor] = {}
         #: admission-side mirror of the scheduler's dedup window (the
         #: pump owns the scheduler, so producers can't read it): seeded
@@ -111,16 +142,19 @@ class IngestFrontend:
         self.admission_s: Deque[float] = deque(maxlen=METRIC_WINDOW)
         self.ticks_per_pump: Deque[int] = deque(maxlen=METRIC_WINDOW)
         self.inflight_bytes_peak = 0
-        self._thread = threading.Thread(
-            target=self._pump_loop, name="reflow-ingest-pump", daemon=True)
+        self._thread: Optional[threading.Thread] = None
         if start:
+            self._thread = threading.Thread(
+                target=self._pump_loop, name="reflow-ingest-pump",
+                daemon=True)
             self._thread.start()
 
     # -- crash seams -------------------------------------------------------
 
     def _crash_point(self, name: str) -> None:
         if self._crash is not None:
-            self._crash.point(name)
+            self._crash.point(
+                name if self.name is None else f"{name}@{self.name}")
 
     # -- producer side -----------------------------------------------------
 
@@ -204,8 +238,10 @@ class IngestFrontend:
                         reason=f"batch of {nbytes}B exceeds the "
                                f"{self._queues.max_bytes}B budget"))
                     return False
+                shed_any = False
                 for e in self._queues.shed_for(source.id, nbytes):
                     self.shed += 1
+                    shed_any = True
                     # the evicted batch never reached the scheduler: drop
                     # it from the dedup mirror so the re-send the SHED
                     # ticket demands is admitted, not DEDUPED away
@@ -213,6 +249,10 @@ class IngestFrontend:
                     e.ticket._resolve(TicketResult(
                         SHED, e.batch_id,
                         reason="shed-oldest backpressure; re-send"))
+                if shed_any:
+                    # freed bytes are budget-wide: a sibling graph's
+                    # blocked producer may fit now
+                    self._budget.notify_room()
                 if self._queues.room_for(source.id, nbytes):
                     return True
                 # executing bytes hold the budget: fall through to wait
@@ -261,7 +301,7 @@ class IngestFrontend:
                 raise GraphError("flush() while paused would never "
                                  "complete; resume() first")
             self._flush_pending = True
-            self._work.notify()
+            self._work.notify_all()
             try:
                 while self._queues.queued_batches or self._executing:
                     if self._state == "failed":
@@ -311,7 +351,7 @@ class IngestFrontend:
     def resume(self) -> None:
         with self._lock:
             self._paused = False
-            self._work.notify()
+            self._work.notify_all()
 
     def close(self, *, flush: bool = True,
               timeout: Optional[float] = None) -> None:
@@ -319,7 +359,12 @@ class IngestFrontend:
         producers with :class:`FrontendClosed`, tick out the remaining
         backlog (``flush=True``) or fail its tickets (``flush=False``),
         stop the pump, and seal a durable scheduler's WAL. Idempotent.
-        """
+
+        On an externally-pumped frontend the draining is done by the
+        pool (which must still be serving — ``ServeTier`` closes graphs
+        before stopping its threads); this call waits for it."""
+        deadline = (None if timeout is None
+                    else time.perf_counter() + timeout)
         with self._lock:
             if self._state in ("closed", "failed"):
                 self._seal()
@@ -332,21 +377,48 @@ class IngestFrontend:
             self._paused = False
             self._not_full.notify_all()
             self._work.notify_all()
-        if self._thread.is_alive():
-            self._thread.join(timeout=timeout)
+        if self._thread is not None:
             if self._thread.is_alive():
-                # the pump is still mid-macro-tick: sealing the WAL now
-                # would close a file it is appending to. Stay "closing"
-                # (admission already refused) and let the caller retry.
-                raise TimeoutError(
-                    f"close() timed out after {timeout}s with the pump "
-                    f"still draining; frontend left in state 'closing' "
-                    f"— call close() again to finish")
+                self._thread.join(timeout=timeout)
+                if self._thread.is_alive():
+                    # the pump is still mid-macro-tick: sealing the WAL
+                    # now would close a file it is appending to. Stay
+                    # "closing" (admission already refused) and let the
+                    # caller retry.
+                    raise TimeoutError(
+                        f"close() timed out after {timeout}s with the "
+                        f"pump still draining; frontend left in state "
+                        f"'closing' — call close() again to finish")
+        else:
+            self._close_external(deadline, timeout)
         with self._lock:
             if self._state != "failed":
                 self._state = "closed"
             self._idle.notify_all()
         self._seal()
+
+    def _close_external(self, deadline: Optional[float],
+                        timeout: Optional[float]) -> None:
+        # externally-pumped shutdown: with flush intent the pool drains
+        # the backlog (closing graphs fire unconditionally in _poll);
+        # without it we only wait out an in-flight window, then strand-
+        # fail whatever is still queued
+        with self._lock:
+            while self._state == "closing" and (
+                    self._executing
+                    or (self._closing_flush
+                        and self._queues.queued_batches)):
+                remaining = (None if deadline is None
+                             else deadline - time.perf_counter())
+                if remaining is not None and remaining <= 0:
+                    raise TimeoutError(
+                        f"close() timed out after {timeout}s with the "
+                        f"pump pool still draining; frontend left in "
+                        f"state 'closing' — call close() again to "
+                        f"finish")
+                self._idle.wait(timeout=remaining)
+            if self._state == "closing" and not self._closing_flush:
+                self._exit_pump_locked()
 
     def _seal(self) -> None:
         closefn = getattr(self.sched, "close", None)
@@ -380,6 +452,38 @@ class IngestFrontend:
             return True, None
         return False, w.max_latency_s - age
 
+    # external-pump surface (the tier's pool; every method below up to
+    # _run_window is called with the shared lock held) ---------------------
+
+    def _poll(self, now: float):
+        """Pool eligibility: (fire, wait_s). Never fires while the
+        in-flight latch is held (single-owner invariant), after a
+        failure, or once closed; a closing graph fires only while a
+        flush-close still has backlog to tick out."""
+        if self._executing or self._state in ("closed", "failed"):
+            return False, None
+        if self._state == "closing":
+            return (self._closing_flush
+                    and self._queues.queued_batches > 0), None
+        return self._fire_or_timeout(now)
+
+    def _take_window(self) -> Dict[int, List[Entry]]:
+        """Claim the backlog as one macro-tick work item and set the
+        in-flight latch; the caller must follow with ``_run_window``
+        (lock released) and ``_finish_window`` (lock re-held)."""
+        drained = self._queues.drain_all()
+        self._flush_pending = False
+        self._executing = True
+        return drained
+
+    def _finish_window(self) -> None:
+        """Release the latch and the window's budget bytes; wake
+        blocked producers (budget-wide) and flush/pause waiters."""
+        self._executing = False
+        self._queues.commit_executing()
+        self._budget.notify_room()
+        self._idle.notify_all()
+
     def _pump_loop(self) -> None:
         try:
             while True:
@@ -395,15 +499,10 @@ class IngestFrontend:
                         if fire:
                             break
                         self._work.wait(timeout=wait_t)
-                    drained = self._queues.drain_all()
-                    self._flush_pending = False
-                    self._executing = True
+                    drained = self._take_window()
                 self._run_window(drained)
                 with self._lock:
-                    self._executing = False
-                    self._queues.commit_executing()
-                    self._not_full.notify_all()
-                    self._idle.notify_all()
+                    self._finish_window()
         except BaseException as e:  # noqa: BLE001 - incl. CrashPoint kills
             self._on_pump_crash(e)
 
@@ -416,6 +515,7 @@ class IngestFrontend:
                 e.ticket._fail(FrontendClosed(
                     f"frontend closed before batch {e.batch_id!r} "
                     f"was ticked"))
+        self._budget.notify_room()
         self._idle.notify_all()
         self._not_full.notify_all()
 
@@ -447,19 +547,30 @@ class IngestFrontend:
             self.ticks_per_pump.append(len(feeds))
         self._window_entries = None
 
-    def _on_pump_crash(self, error: BaseException) -> None:
+    def _on_pump_crash(self, error: BaseException,
+                       window: Optional[Dict[int, List[Entry]]] = None,
+                       ) -> None:
+        """Fail the frontend after its pump died: every undecided ticket
+        of the in-flight window and the stranded backlog resolves with
+        :class:`PumpCrashed`, blocked producers are released, and the
+        graph's budget bytes return to the pool. On a tier, only THIS
+        graph fails — the pool thread survives and keeps serving
+        siblings (``window`` carries the drained entries when the crash
+        fired before ``_run_window`` stamped them)."""
         with self._lock:
             self._state = "failed"
             self.pump_error = error
             self._executing = False
             stranded = self._queues.drain_all()
             self._queues.commit_executing()
+            self._budget.notify_room()
             self._not_full.notify_all()
             self._work.notify_all()
             self._idle.notify_all()
         crash = PumpCrashed(f"ingest pump died: {error!r}")
         crash.__cause__ = error
-        window = getattr(self, "_window_entries", None) or {}
+        if window is None:
+            window = getattr(self, "_window_entries", None) or {}
         for entries in list(window.values()) + list(stranded.values()):
             for e in entries:
                 if not e.ticket.done():

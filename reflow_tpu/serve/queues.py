@@ -1,12 +1,16 @@
-"""Admission queues: per-source bounded FIFOs under a global byte budget.
+"""Admission queues: per-source bounded FIFOs under an injected byte
+budget.
 
 Pure data structure — every method is called with the frontend's lock
 held; no locking happens here. The two admission limits compose:
 
 - ``max_batches`` bounds each SOURCE's queue depth (a slow source can't
   starve the rest);
-- ``max_bytes`` bounds the TOTAL in-flight payload (queued + currently
-  executing), the memory backstop for "millions of users" traffic.
+- the :class:`~reflow_tpu.serve.budget.BudgetShare` bounds the TOTAL
+  in-flight payload (queued + currently executing) — per frontend when
+  the frontend built its own budget, across every graph of a
+  ``ServeTier`` when the share belongs to a tier-wide
+  ``AdmissionBudget``.
 
 What happens when a limit is hit is the frontend's backpressure policy
 (``block`` / ``reject`` / ``shed-oldest``); this module only answers
@@ -52,9 +56,11 @@ class Entry:
 
 
 class SourceQueues:
-    def __init__(self, max_batches: int, max_bytes: int):
+    def __init__(self, max_batches: int, budget):
         self.max_batches = max_batches
-        self.max_bytes = max_bytes
+        #: BudgetShare holding this graph's in-flight bytes (queued +
+        #: executing); acquire on push, release on shed/commit
+        self.budget = budget
         self._q: Dict[int, Deque[Entry]] = {}
         self.queued_batches = 0
         self.queued_rows = 0
@@ -63,43 +69,50 @@ class SourceQueues:
         #: committed — still counted against the budget
         self.executing_bytes = 0
 
+    @property
+    def max_bytes(self) -> int:
+        """This frontend's effective byte cap (guaranteed-reachable
+        in-flight total) — the reject-reason bound."""
+        return self.budget.max_alone
+
     # -- admission ---------------------------------------------------------
 
     def room_for(self, source_id: int, nbytes: int) -> bool:
         depth = len(self._q.get(source_id, ()))
-        return (depth < self.max_batches
-                and self.queued_bytes + self.executing_bytes + nbytes
-                <= self.max_bytes)
+        return depth < self.max_batches and self.budget.room_for(nbytes)
 
     def fits_alone(self, nbytes: int) -> bool:
         """Could this batch EVER be admitted (empty queues)? False means
         the batch alone exceeds the byte budget — reject, don't shed."""
-        return nbytes <= self.max_bytes
+        return self.budget.fits_alone(nbytes)
 
     def push(self, entry: Entry) -> None:
         self._q.setdefault(entry.source.id, deque()).append(entry)
         self.queued_batches += 1
         self.queued_rows += entry.rows
         self.queued_bytes += entry.nbytes
+        self.budget.acquire(entry.nbytes)
 
     def shed_for(self, source_id: int, nbytes: int) -> List[Entry]:
         """Evict oldest-first until ``room_for`` holds: first from the
         submitting source's own queue (depth limit), then globally
-        oldest (byte budget). Returns the evicted entries — the caller
-        resolves their tickets as SHED."""
+        oldest (byte budget; only THIS graph's entries are sheddable —
+        a tier sibling's backlog is never another graph's to evict).
+        Returns the evicted entries — the caller resolves their tickets
+        as SHED."""
         out: List[Entry] = []
         q = self._q.get(source_id)
         while q and len(q) >= self.max_batches:
             out.append(self._pop_entry(q))
-        while (self.queued_bytes + self.executing_bytes + nbytes
-               > self.max_bytes):
+        while not self.budget.room_for(nbytes):
             oldest: Optional[Deque[Entry]] = None
             for dq in self._q.values():
                 if dq and (oldest is None
                            or dq[0].t_admitted < oldest[0].t_admitted):
                     oldest = dq
             if oldest is None:
-                break  # nothing left to shed (executing bytes dominate)
+                break  # nothing left to shed (executing bytes or a
+                # sibling graph's admissions hold the budget)
             out.append(self._pop_entry(oldest))
         return out
 
@@ -108,6 +121,7 @@ class SourceQueues:
         self.queued_batches -= 1
         self.queued_rows -= e.rows
         self.queued_bytes -= e.nbytes
+        self.budget.release(e.nbytes)
         return e
 
     # -- pump side ---------------------------------------------------------
@@ -132,8 +146,8 @@ class SourceQueues:
 
     def drain_all(self) -> Dict[int, List[Entry]]:
         """Take the whole backlog (per-source FIFO order preserved);
-        their bytes move to ``executing_bytes`` until the caller calls
-        :meth:`commit_executing`."""
+        their bytes move to ``executing_bytes`` — still held against
+        the budget — until the caller calls :meth:`commit_executing`."""
         out = {sid: list(dq) for sid, dq in self._q.items() if dq}
         self.executing_bytes += self.queued_bytes
         self._q.clear()
@@ -143,4 +157,6 @@ class SourceQueues:
         return out
 
     def commit_executing(self) -> None:
+        if self.executing_bytes:
+            self.budget.release(self.executing_bytes)
         self.executing_bytes = 0

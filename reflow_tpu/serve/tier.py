@@ -1,0 +1,349 @@
+"""ServeTier: many named graphs behind one budget and one pump pool.
+
+One host serves many incremental graphs (per-tenant pagerank, tfidf,
+knn …). Standalone ``IngestFrontend``\\ s give each graph a private pump
+thread and a private byte budget — N graphs means N unmanaged threads
+and no global memory bound. The tier multiplexes instead:
+
+- **one** :class:`~reflow_tpu.serve.budget.AdmissionBudget` spans every
+  graph (global in-flight bytes), with per-graph ``floor_bytes``
+  (guaranteed reservation — a hot tenant can never push a sibling below
+  it) and ``ceiling_bytes`` (hard cap on one graph's usage);
+- **one pump pool** of K threads pulls coalesced macro-tick work items
+  from the per-graph ready set, picked by deficit-weighted round-robin
+  on configured QoS ``weight``\\ s (:func:`dwrr_pick`): over time a
+  ready graph receives service proportional to its weight, in units of
+  rows served, regardless of how bursty its siblings are.
+
+Single-owner invariant: a scheduler is only ever driven by one thread
+at a time. Each graph carries an in-flight latch (the frontend's
+``_executing`` flag); a latched graph is simply not ready, so its
+macro-tick never interleaves with itself — the pool adds concurrency
+ACROSS graphs, never within one.
+
+Concurrency design — one shared lock: the tier's lock is *the* lock of
+every registered frontend, every producer-wakeup condition, and the
+budget. This is what makes cross-graph wakeups (graph A's commit frees
+bytes graph B's producer is blocked on) deadlock-free by construction:
+there is no second lock to order against. The pool holds the lock only
+to pick/latch work; macro-tick execution runs unlocked.
+
+Reuse, not fork: admission, dedup (``SourceCursor`` + mirror),
+coalescing, ticket resolution, and crash semantics all live in the
+PR-2 frontend — the tier injects its budget/lock/work-condition and
+drives the frontend's external-pump surface (``_poll`` /
+``_take_window`` / ``_run_window`` / ``_finish_window``). Durable
+graphs keep their own WAL; the pool's window IS the group-commit
+window (``DurableScheduler.tick_many`` → ``append_group``, one fsync
+per macro-tick).
+
+Failure isolation: a crash inside one graph's macro-tick
+(``pool_window@<name>`` / ``pump_*@<name>`` seams) fails THAT graph —
+its undecided tickets resolve :class:`PumpCrashed`, its bytes return
+to the pool — and the worker thread survives to keep serving siblings.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+from reflow_tpu.graph import GraphError
+
+from .budget import AdmissionBudget
+from .coalesce import CoalesceWindow
+from .frontend import METRIC_WINDOW, IngestFrontend
+
+__all__ = ["GraphConfig", "GraphHandle", "ServeTier", "dwrr_pick"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GraphConfig:
+    """Per-graph QoS and admission knobs for :meth:`ServeTier.register`.
+
+    ``weight`` is the DWRR service share (relative rows/s under
+    contention). ``floor_bytes`` / ``ceiling_bytes`` are this graph's
+    guaranteed / maximum slice of the tier's byte budget. ``policy`` /
+    ``queue_batches`` / ``window`` are the frontend's backpressure
+    policy, per-source depth bound, and coalescing window.
+    """
+
+    weight: float = 1.0
+    floor_bytes: int = 0
+    ceiling_bytes: Optional[int] = None
+    policy: str = "block"
+    queue_batches: int = 256
+    window: Optional[CoalesceWindow] = None
+    crash: Optional[object] = None  # CrashInjector override (tests)
+
+
+def dwrr_pick(ready: List["GraphHandle"],
+              quantum_rows: int) -> "GraphHandle":
+    """Deficit-weighted round-robin over the ready graphs.
+
+    Each graph carries a rolling deficit in row units. When every ready
+    graph is out of deficit, all of them are replenished by
+    ``weight * quantum_rows``; the pick is the largest positive
+    deficit, and the caller charges the rows actually served after the
+    window runs. Long-run service among continuously-ready graphs is
+    therefore proportional to weight, independent of burst shape; a
+    graph that is rarely ready is never replenished in absentia, so it
+    cannot hoard deficit and then monopolize the pool.
+    """
+    while all(h._deficit <= 0 for h in ready):
+        for h in ready:
+            h._deficit += h.config.weight * quantum_rows
+    return max((h for h in ready if h._deficit > 0),
+               key=lambda h: h._deficit)
+
+
+class GraphHandle:
+    """One registered graph: the producer-facing proxy plus the tier's
+    per-graph scheduling state. Returned by :meth:`ServeTier.register`;
+    ``submit`` / ``flush`` / ``drain`` forward to the underlying
+    :class:`IngestFrontend` (``handle.frontend`` for everything else)."""
+
+    def __init__(self, tier: "ServeTier", name: str,
+                 frontend: IngestFrontend, config: GraphConfig):
+        self.tier = tier
+        self.name = name
+        self.frontend = frontend
+        self.config = config
+        # -- pool scheduling state (under the tier lock) --
+        self._deficit = 0.0
+        #: when the graph's current ready stretch began (None while not
+        #: ready / latched) — scheduling delay is sampled on pick
+        self._ready_since: Optional[float] = None
+        self.windows = 0
+        self.rows_applied = 0
+        self.sched_delay_s: Deque[float] = deque(maxlen=METRIC_WINDOW)
+
+    @property
+    def weight(self) -> float:
+        return self.config.weight
+
+    def submit(self, source, batch, **kw):
+        return self.frontend.submit(source, batch, **kw)
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        self.frontend.flush(timeout)
+
+    def drain(self, source=None, **kw) -> int:
+        return self.frontend.drain(source, **kw)
+
+    def __repr__(self) -> str:
+        return (f"GraphHandle({self.name!r}, weight={self.config.weight}, "
+                f"state={self.frontend._state!r})")
+
+
+class ServeTier:
+    """Host many named graphs on one admission budget and one pump pool.
+
+    ``max_bytes``: the tier-wide in-flight payload budget shared by all
+    graphs. ``pump_threads``: pool size K (macro-ticks of *different*
+    graphs run concurrently; one graph is always single-owner).
+    ``quantum_rows``: the DWRR replenish quantum. ``crash``: a
+    ``CrashInjector`` for the pool seams (tests only).
+    """
+
+    def __init__(self, *, max_bytes: int = 256 << 20,
+                 pump_threads: int = 2, quantum_rows: int = 4096,
+                 crash=None):
+        if pump_threads <= 0:
+            raise ValueError(
+                f"pump_threads must be positive, got {pump_threads}")
+        self.quantum_rows = quantum_rows
+        self._crash = crash
+        self._lock = threading.Lock()
+        #: the pool's (and every frontend's) work condition: producers
+        #: notify on admit, workers notify on window finish
+        self._work = threading.Condition(self._lock)
+        self.budget = AdmissionBudget(max_bytes)
+        self._graphs: Dict[str, GraphHandle] = {}
+        self._closed = False
+        # -- counters (utils.metrics.summarize_tier) --
+        self.windows = 0
+        self.pool_crashes = 0
+        self._busy_s = 0.0
+        self._t0 = time.perf_counter()
+        self.pump_threads = pump_threads
+        self._threads = [
+            threading.Thread(target=self._pool_loop,
+                             name=f"reflow-tier-pump-{i}", daemon=True)
+            for i in range(pump_threads)]
+        for t in self._threads:
+            t.start()
+
+    # -- registry ----------------------------------------------------------
+
+    def register(self, name: str, sched,
+                 config: Optional[GraphConfig] = None) -> GraphHandle:
+        """Host ``sched`` (Dirty- or DurableScheduler) as graph
+        ``name``. The scheduler must not be driven directly from now
+        until :meth:`unregister` — the pool owns it."""
+        cfg = config if config is not None else GraphConfig()
+        if cfg.weight <= 0:
+            raise ValueError(
+                f"QoS weight must be positive, got {cfg.weight} "
+                f"for {name!r}")
+        with self._lock:
+            if self._closed:
+                raise GraphError("tier is closed; register refused")
+            if name in self._graphs:
+                raise ValueError(f"graph {name!r} already registered")
+            share = self.budget.register(
+                name, floor=cfg.floor_bytes, ceiling=cfg.ceiling_bytes)
+            try:
+                fe = IngestFrontend(
+                    sched, policy=cfg.policy,
+                    queue_batches=cfg.queue_batches, window=cfg.window,
+                    crash=cfg.crash if cfg.crash is not None
+                    else self._crash,
+                    start=False, budget=share, lock=self._lock,
+                    work=self._work, name=name)
+            except BaseException:
+                self.budget.unregister(name)
+                raise
+            handle = GraphHandle(self, name, fe, cfg)
+            self._graphs[name] = handle
+            return handle
+
+    def handle(self, name: str) -> GraphHandle:
+        with self._lock:
+            return self._graphs[name]
+
+    def graphs(self) -> Dict[str, GraphHandle]:
+        with self._lock:
+            return dict(self._graphs)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def drain(self, name: str, source=None, **kw) -> int:
+        """Quiesce one graph in place (flush its backlog, run the
+        scheduler's deferred-fixpoint drain) without unregistering it —
+        siblings keep ticking throughout. Returns the drain tick
+        count."""
+        return self.handle(name).drain(source, **kw)
+
+    def unregister(self, name: str, *, flush: bool = True,
+                   timeout: Optional[float] = None) -> GraphHandle:
+        """Quiesce and remove one graph: admission stops, blocked
+        producers are released with ``FrontendClosed``, the pool ticks
+        out its backlog (``flush=True``) or its tickets fail
+        (``flush=False``), the scheduler's WAL (if durable) is sealed,
+        and its budget share returns to the pool. Siblings never stall:
+        the pool keeps serving them while this graph drains."""
+        with self._lock:
+            h = self._graphs.get(name)
+            if h is None:
+                raise KeyError(f"no graph {name!r} registered")
+        h.frontend.close(flush=flush, timeout=timeout)
+        with self._lock:
+            self._graphs.pop(name, None)
+            self.budget.unregister(name)
+        return h
+
+    def close(self, *, flush: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Drain and unregister every graph, then stop the pool.
+        Idempotent."""
+        with self._lock:
+            names = list(self._graphs)
+        for n in names:
+            try:
+                self.unregister(n, flush=flush, timeout=timeout)
+            except KeyError:
+                pass  # a concurrent unregister won the race
+        with self._lock:
+            self._closed = True
+            self._work.notify_all()
+        for t in self._threads:
+            t.join(timeout=timeout)
+            if t.is_alive():
+                raise TimeoutError(
+                    f"tier close() timed out after {timeout}s waiting "
+                    f"for {t.name}")
+
+    def __enter__(self) -> "ServeTier":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(flush=exc == (None, None, None))
+
+    # -- metrics -----------------------------------------------------------
+
+    @property
+    def pump_utilization(self) -> float:
+        """Busy-fraction of the pool since construction: macro-tick
+        seconds / (threads x wall seconds)."""
+        elapsed = time.perf_counter() - self._t0
+        if elapsed <= 0:
+            return 0.0
+        return self._busy_s / (self.pump_threads * elapsed)
+
+    # -- the pool ----------------------------------------------------------
+
+    def _pool_loop(self) -> None:
+        while True:
+            with self._lock:
+                picked = None
+                while picked is None:
+                    if self._closed:
+                        return
+                    now = time.perf_counter()
+                    ready: List[GraphHandle] = []
+                    wait_t: Optional[float] = None
+                    for h in self._graphs.values():
+                        fire, w = h.frontend._poll(now)
+                        if fire:
+                            if h._ready_since is None:
+                                h._ready_since = now
+                            ready.append(h)
+                        else:
+                            # not ready (or latched by a sibling
+                            # worker): the ready stretch is over
+                            h._ready_since = None
+                            if w is not None:
+                                wait_t = (w if wait_t is None
+                                          else min(wait_t, w))
+                    if ready:
+                        picked = dwrr_pick(ready, self.quantum_rows)
+                        picked.sched_delay_s.append(
+                            now - picked._ready_since)
+                        picked._ready_since = None
+                        drained = picked.frontend._take_window()
+                    else:
+                        self._work.wait(timeout=wait_t)
+            # -- macro-tick, unlocked (single-owner: the latch set by
+            # _take_window keeps every other worker off this graph) --
+            t0 = time.perf_counter()
+            crashed = False
+            try:
+                if self._crash is not None:
+                    self._crash.point(f"pool_window@{picked.name}")
+                picked.frontend._run_window(drained)
+            except BaseException as e:  # noqa: BLE001 - fault isolation
+                crashed = True
+                picked.frontend._on_pump_crash(e, window=drained)
+            busy = time.perf_counter() - t0
+            rows = sum(e.rows for entries in drained.values()
+                       for e in entries)
+            with self._lock:
+                self._busy_s += busy
+                self.windows += 1
+                picked.windows += 1
+                picked._deficit -= max(rows, 1)
+                if crashed:
+                    self.pool_crashes += 1
+                    # _on_pump_crash already released the latch, the
+                    # graph's bytes, and its blocked producers
+                else:
+                    picked.rows_applied += rows
+                    picked.frontend._finish_window()
+                # re-evaluate readiness pool-wide: the just-unlatched
+                # graph may have accrued backlog, and idle workers only
+                # wake on notify
+                self._work.notify_all()

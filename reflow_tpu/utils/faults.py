@@ -54,7 +54,16 @@ class CrashInjector:
     seams whose name contains the substring (e.g. ``"append"`` to die
     inside the WAL write path, ``"after_push"`` to die between push and
     tick, ``"pump"`` to kill the serve frontend's pump thread).
-    ``fired`` records whether the kill happened.
+    ``fired`` records whether the kill happened; ``fired_seam`` which
+    seam it happened at.
+
+    A tier-hosted frontend (``serve.tier.ServeTier``) scopes every seam
+    name with its graph: ``pump_before_tick@analytics``, plus the
+    pool's own pre-window seam ``pool_window@analytics``. So
+    ``only="@analytics"`` kills exactly one graph's macro-tick on a
+    shared pump pool — the fault-isolation property the tier tests
+    assert (that graph's tickets fail ``PumpCrashed``; the worker
+    thread survives and siblings keep ticking).
 
     Seam visits are counted under a lock: the serve frontend fires its
     seams from N producer threads (``producer_submit`` /
@@ -68,6 +77,7 @@ class CrashInjector:
         self.remaining = at
         self.only = only
         self.fired = False
+        self.fired_seam: Optional[str] = None
         self.seams: List[str] = []
         self._lock = threading.Lock()
 
@@ -80,6 +90,7 @@ class CrashInjector:
             self.remaining -= 1
             if self.remaining <= 0:
                 self.fired = True
+                self.fired_seam = name
                 raise CrashPoint(name)
 
 

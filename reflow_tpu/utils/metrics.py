@@ -15,8 +15,24 @@ from typing import List, Sequence
 
 import numpy as np
 
-__all__ = ["MetricsSummary", "ServeMetrics", "WalMetrics", "summarize",
-           "summarize_serve", "summarize_wal", "profile_trace"]
+__all__ = ["MetricsSummary", "ServeMetrics", "TierMetrics", "WalMetrics",
+           "summarize", "summarize_serve", "summarize_tier",
+           "summarize_wal", "profile_trace"]
+
+
+def _jsonify(obj):
+    """Recursively coerce numpy scalars/arrays to plain Python so the
+    result survives ``json.dumps`` — the bench writes metric records to
+    JSON so runs can be diffed across PRs."""
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, dict):
+        return {k: _jsonify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonify(v) for v in obj]
+    return obj
 
 
 @dataclasses.dataclass
@@ -116,6 +132,11 @@ class WalMetrics:
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
 
+    def to_dict(self) -> dict:
+        """``as_dict`` with every value JSON-serializable (numpy
+        scalars coerced) — the cross-PR diffable export."""
+        return _jsonify(dataclasses.asdict(self))
+
 
 def summarize_wal(wal, recovery=None) -> WalMetrics:
     """Aggregate a ``wal.WriteAheadLog``'s counters (and optionally a
@@ -170,6 +191,11 @@ class ServeMetrics:
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
 
+    def to_dict(self) -> dict:
+        """``as_dict`` with every value JSON-serializable (numpy
+        scalars coerced) — the cross-PR diffable export."""
+        return _jsonify(dataclasses.asdict(self))
+
 
 def summarize_serve(frontend) -> ServeMetrics:
     """Aggregate an ``IngestFrontend``'s counters into one record."""
@@ -193,6 +219,89 @@ def summarize_serve(frontend) -> ServeMetrics:
         admission_p95_s=pct(frontend.admission_s, 95),
         queue_depth_p95=pct(frontend.queue_depth_samples, 95),
         inflight_bytes_peak=frontend.inflight_bytes_peak,
+    )
+
+
+@dataclasses.dataclass
+class TierMetrics:
+    """Multi-graph serving-tier observability (``serve.tier``): pool
+    health (utilization, windows, crash count), shared-budget occupancy,
+    and cross-graph scheduling delay — the time a ready graph waited for
+    a pool thread, the number QoS weighting is supposed to keep bounded
+    for quiet tenants under a hot sibling.
+
+    ``per_graph`` nests each live graph's ``ServeMetrics.to_dict()``
+    plus its QoS/budget/pool view (weight, floor/ceiling, bytes used and
+    peak, windows served, rows applied, scheduling-delay and admission
+    p99, frontend state).
+    """
+
+    graphs: int
+    pump_threads: int
+    windows: int
+    pool_crashes: int
+    pump_utilization: float
+    budget_total_bytes: int
+    budget_used_bytes: int
+    budget_peak_bytes: int
+    #: high-water shared-budget occupancy fraction (peak/total)
+    budget_occupancy_peak: float
+    sched_delay_p50_s: float
+    sched_delay_p99_s: float
+    per_graph: dict
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def to_dict(self) -> dict:
+        """``as_dict`` with every value JSON-serializable (numpy
+        scalars coerced) — the cross-PR diffable export."""
+        return _jsonify(dataclasses.asdict(self))
+
+
+def summarize_tier(tier) -> TierMetrics:
+    """Aggregate a ``serve.ServeTier``'s pool/budget counters and every
+    live graph's frontend counters into one record."""
+    def pct(xs, q: float) -> float:
+        return float(np.percentile(xs, q)) if len(xs) else 0.0
+
+    handles = tier.graphs()
+    shares = tier.budget.shares()
+    per_graph = {}
+    all_delays: List[float] = []
+    for name, h in handles.items():
+        fe = h.frontend
+        g = summarize_serve(fe).to_dict()
+        share = shares.get(name)
+        g.update(
+            weight=h.config.weight,
+            floor_bytes=h.config.floor_bytes,
+            ceiling_bytes=(share.ceiling if share is not None
+                           else h.config.ceiling_bytes),
+            bytes_used=share.used if share is not None else 0,
+            bytes_peak=share.peak if share is not None else 0,
+            windows=h.windows,
+            rows_applied=h.rows_applied,
+            sched_delay_p50_s=pct(h.sched_delay_s, 50),
+            sched_delay_p99_s=pct(h.sched_delay_s, 99),
+            admission_p99_s=pct(fe.admission_s, 99),
+            state=fe._state,
+        )
+        per_graph[name] = g
+        all_delays.extend(h.sched_delay_s)
+    return TierMetrics(
+        graphs=len(handles),
+        pump_threads=tier.pump_threads,
+        windows=tier.windows,
+        pool_crashes=tier.pool_crashes,
+        pump_utilization=tier.pump_utilization,
+        budget_total_bytes=tier.budget.total_bytes,
+        budget_used_bytes=tier.budget.used,
+        budget_peak_bytes=tier.budget.peak,
+        budget_occupancy_peak=tier.budget.peak / tier.budget.total_bytes,
+        sched_delay_p50_s=pct(all_delays, 50),
+        sched_delay_p99_s=pct(all_delays, 99),
+        per_graph=per_graph,
     )
 
 

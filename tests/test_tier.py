@@ -1,0 +1,415 @@
+"""Multi-graph serving-tier tests (``reflow_tpu.serve.tier``).
+
+The contract under test, on top of ``test_serve.py``'s frontend
+properties: (a) K pump threads serving N graphs preserve each graph's
+differential equality with a bare loop AND the single-owner invariant
+(one graph's macro-tick never runs concurrently with itself), (b) the
+shared budget's floors/ceilings isolate tenants — a hot graph hits its
+ceiling while a floored sibling keeps admitting, (c) lifecycle is
+per-graph: drain/unregister/pump-crash on one graph leave its siblings
+ticking, and only ``tier.close()`` stops the pool.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from reflow_tpu.graph import GraphError
+from reflow_tpu.scheduler import DirtyScheduler
+from reflow_tpu.serve import (AdmissionBudget, CoalesceWindow,
+                              FrontendClosed, GraphConfig, GraphHandle,
+                              PumpCrashed, ServeTier, dwrr_pick)
+from reflow_tpu.utils.faults import CrashInjector
+from reflow_tpu.utils.metrics import (summarize_serve, summarize_tier,
+                                      summarize_wal)
+from reflow_tpu.wal import DurableScheduler, WriteAheadLog, recover
+from reflow_tpu.workloads import wordcount
+
+WINDOW = CoalesceWindow(max_rows=256, max_ticks=8, max_latency_s=0.002)
+
+
+def make_graph():
+    g, src, sink = wordcount.build_graph()
+    return DirtyScheduler(g), src, sink
+
+
+def lines_batch(*words: str):
+    return wordcount.ingest_lines([" ".join(words)])
+
+
+def config(**kw):
+    kw.setdefault("window", WINDOW)
+    return GraphConfig(**kw)
+
+
+# -- correctness across the pool --------------------------------------------
+
+def test_multi_graph_differential_matches_bare_loops():
+    tier = ServeTier(max_bytes=8 << 20, pump_threads=2)
+    graphs = {}
+    for i in range(3):
+        sched, src, sink = make_graph()
+        h = tier.register(f"g{i}", sched, config())
+        graphs[f"g{i}"] = (h, sched, src, sink)
+    payload = lambda g, p, j: lines_batch(f"{g}w{p}", f"w{(p + j) % 5}")
+
+    def produce(name, p):
+        h, _sched, src, _sink = graphs[name]
+        for j in range(20):
+            r = h.submit(src, payload(name, p, j)).result(timeout=10)
+            assert r.applied
+
+    threads = [threading.Thread(target=produce, args=(n, p))
+               for n in graphs for p in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for name, (h, sched, src, sink) in graphs.items():
+        h.flush(timeout=10)
+        want_sched, want_src, want_sink = make_graph()
+        for p in range(2):
+            for j in range(20):
+                want_sched.push(want_src, payload(name, p, j))
+                want_sched.tick()
+        assert dict(sched.view(sink.name)) == dict(
+            want_sched.view(want_sink.name))
+        assert sched.forced_syncs == 0
+    tier.close()
+
+
+def test_single_owner_latch_never_interleaves_one_graph():
+    # wrap each scheduler's tick_many in a non-blocking per-graph mutex:
+    # if the pool ever ran one graph's macro-tick concurrently with
+    # itself, acquire(blocking=False) fails and the window crashes loud
+    tier = ServeTier(max_bytes=8 << 20, pump_threads=4)
+    graphs, violations = {}, []
+    for i in range(3):
+        sched, src, sink = make_graph()
+        owner = threading.Lock()
+        real = sched.tick_many
+
+        def guarded(feeds, *a, owner=owner, real=real, **kw):
+            if not owner.acquire(blocking=False):
+                violations.append("concurrent tick_many on one graph")
+                raise AssertionError(violations[-1])
+            try:
+                time.sleep(0.001)  # widen the race window
+                return real(feeds, *a, **kw)
+            finally:
+                owner.release()
+
+        sched.tick_many = guarded
+        h = tier.register(f"g{i}", sched, config())
+        graphs[f"g{i}"] = (h, src)
+
+    def produce(name, p):
+        h, src = graphs[name]
+        for j in range(15):
+            h.submit(src, lines_batch(f"{name}p{p}j{j}"))
+
+    threads = [threading.Thread(target=produce, args=(n, p))
+               for n in graphs for p in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for h, _src in graphs.values():
+        h.flush(timeout=10)
+    tier.close()
+    assert not violations
+
+
+# -- shared budget: floors and ceilings -------------------------------------
+
+def test_ceiling_caps_hot_graph_while_floored_sibling_admits():
+    tier = ServeTier(max_bytes=4096, pump_threads=2)
+    hot_sched, hot_src, _ = make_graph()
+    hot = tier.register("hot", hot_sched, config(
+        policy="reject", ceiling_bytes=1024))
+    quiet_sched, quiet_src, _ = make_graph()
+    quiet = tier.register("quiet", quiet_sched, config(floor_bytes=1024))
+    hot.frontend.pause()
+    quiet.frontend.pause()
+    # fill the hot graph to its ceiling: admissions then REJECT even
+    # though the tier-wide budget still has room
+    hot_results = []
+    for j in range(4096):
+        t = hot.submit(hot_src, lines_batch(f"h{j}", "x", "y"))
+        if t.done() and t.result().status == "rejected":
+            hot_results.append(t.result())
+            break
+    assert hot_results, "hot graph never hit its ceiling"
+    assert "exceeds" not in (hot_results[0].reason or "")
+    share = tier.budget.shares()["hot"]
+    assert share.used <= 1024 < tier.budget.total_bytes
+    # the floored sibling still admits instantly (block policy, but
+    # room is guaranteed by its reservation)
+    t = quiet.submit(quiet_src, lines_batch("q"), timeout=0.5)
+    assert not t.done()  # queued (pump paused), not rejected
+    quiet.frontend.resume()
+    hot.frontend.resume()
+    assert t.result(timeout=10).applied
+    tier.close()
+
+
+def test_budget_floor_validation():
+    tier = ServeTier(max_bytes=1000, pump_threads=1)
+    s1, *_ = make_graph()
+    tier.register("a", s1, config(floor_bytes=700))
+    s2, *_ = make_graph()
+    with pytest.raises(ValueError, match="not reservable"):
+        tier.register("b", s2, config(floor_bytes=400))
+    with pytest.raises(ValueError, match="floor <= ceiling"):
+        AdmissionBudget(1000).register("c", floor=500, ceiling=400)
+    with pytest.raises(ValueError, match="exceeds"):
+        AdmissionBudget(1000).register("d", ceiling=2000)
+    tier.close()
+
+
+def test_register_validation_and_close_refusal():
+    tier = ServeTier(max_bytes=4096, pump_threads=1)
+    sched, *_ = make_graph()
+    tier.register("a", sched, config())
+    dup, *_ = make_graph()
+    with pytest.raises(ValueError, match="already registered"):
+        tier.register("a", dup, config())
+    bad, *_ = make_graph()
+    with pytest.raises(ValueError, match="weight"):
+        tier.register("b", bad, config(weight=0))
+    tier.close()
+    late, *_ = make_graph()
+    with pytest.raises(GraphError, match="closed"):
+        tier.register("late", late, config())
+    with pytest.raises(KeyError):
+        tier.unregister("never-there")
+
+
+# -- DWRR scheduling ---------------------------------------------------------
+
+def test_dwrr_pick_serves_proportionally_to_weight():
+    tier = ServeTier(max_bytes=1 << 20, pump_threads=1)
+    a = GraphHandle(tier, "a", None, GraphConfig(weight=3.0))
+    b = GraphHandle(tier, "b", None, GraphConfig(weight=1.0))
+    served = {"a": 0, "b": 0}
+    for _ in range(400):
+        h = dwrr_pick([a, b], quantum_rows=100)
+        served[h.name] += 100
+        h._deficit -= 100  # the pool charges rows served
+    assert served["a"] / served["b"] == pytest.approx(3.0, rel=0.1)
+    tier.close()
+
+
+def test_dwrr_ignores_absent_graphs():
+    tier = ServeTier(max_bytes=1 << 20, pump_threads=1)
+    a = GraphHandle(tier, "a", None, GraphConfig(weight=1.0))
+    b = GraphHandle(tier, "b", None, GraphConfig(weight=100.0))
+    # b is never ready: only a is offered, so only a is replenished —
+    # b cannot accumulate deficit in absentia and then starve a
+    for _ in range(50):
+        assert dwrr_pick([a], quantum_rows=10) is a
+        a._deficit -= 10
+    assert b._deficit == 0.0
+    tier.close()
+
+
+# -- lifecycle: per-graph vs tier-wide --------------------------------------
+
+def test_unregister_releases_blocked_producers_and_spares_siblings():
+    tier = ServeTier(max_bytes=1 << 20, pump_threads=2)
+    vic_sched, vic_src, _ = make_graph()
+    victim = tier.register("victim", vic_sched, config(
+        ceiling_bytes=256))
+    sib_sched, sib_src, sib_sink = make_graph()
+    sib = tier.register("sib", sib_sched, config())
+    victim.frontend.pause()
+    # saturate the victim's tiny ceiling so the NEXT submit blocks:
+    # stop while there is still room, the blocked thread takes the
+    # first admission that does not fit
+    from reflow_tpu.serve import batch_nbytes
+    probe = batch_nbytes(lines_batch("v", "w", "x"))
+    share = tier.budget.shares()["victim"]
+    while share.room_for(probe):
+        victim.submit(vic_src, lines_batch("v", "w", "x"))
+    blocked_err = []
+
+    def blocked():
+        try:
+            victim.submit(vic_src, lines_batch("blocked", "b", "c"))
+        except FrontendClosed as e:
+            blocked_err.append(e)
+
+    th = threading.Thread(target=blocked)
+    th.start()
+    time.sleep(0.05)
+    assert th.is_alive(), "producer should be blocked on admission"
+    tier.unregister("victim", flush=False, timeout=10)
+    th.join(timeout=5)
+    assert not th.is_alive() and blocked_err
+    assert "victim" not in tier.graphs()
+    assert "victim" not in tier.budget.shares()
+    # the sibling never noticed
+    r = sib.submit(sib_src, lines_batch("still", "alive")).result(10)
+    assert r.applied
+    tier.close()
+
+
+def test_tier_drain_quiesces_one_graph_while_sibling_ticks():
+    tier = ServeTier(max_bytes=8 << 20, pump_threads=2)
+    a_sched, a_src, a_sink = make_graph()
+    a = tier.register("a", a_sched, config())
+    b_sched, b_src, _ = make_graph()
+    b = tier.register("b", b_sched, config())
+    for j in range(10):
+        a.submit(a_src, lines_batch(f"a{j}"))
+    ticks = tier.drain("a")
+    assert ticks >= 1
+    assert a_sched.quiescent() if hasattr(a_sched, "quiescent") else True
+    assert dict(a_sched.view(a_sink.name))  # backlog landed
+    r = b.submit(b_src, lines_batch("b-live")).result(10)
+    assert r.applied
+    tier.close()
+
+
+def test_tier_close_is_idempotent_and_final():
+    tier = ServeTier(max_bytes=1 << 20, pump_threads=2)
+    sched, src, sink = make_graph()
+    h = tier.register("g", sched, config())
+    tks = [h.submit(src, lines_batch(f"w{j}")) for j in range(25)]
+    tier.close()
+    assert all(t.result(timeout=5).applied for t in tks)
+    assert dict(sched.view(sink.name))
+    tier.close()  # idempotent
+    with pytest.raises(FrontendClosed):
+        h.submit(src, lines_batch("late"))
+
+
+# -- pump-pool crash isolation ----------------------------------------------
+
+def test_pool_crash_fails_only_the_latched_graph():
+    crash = CrashInjector(at=1, only="pool_window@doomed")
+    tier = ServeTier(max_bytes=8 << 20, pump_threads=2, crash=crash)
+    d_sched, d_src, _ = make_graph()
+    doomed = tier.register("doomed", d_sched, config())
+    s_sched, s_src, _ = make_graph()
+    sib = tier.register("sib", s_sched, config())
+    assert sib.submit(s_src, lines_batch("before")).result(10).applied
+    tks = []
+    for j in range(10):
+        try:
+            tks.append(doomed.submit(d_src, lines_batch(f"d{j}")))
+        except FrontendClosed:
+            break  # the crash already landed mid-loop
+    statuses = {"crashed": 0, "applied": 0}
+    for t in tks:
+        try:
+            t.result(timeout=10)
+            statuses["applied"] += 1
+        except PumpCrashed:
+            statuses["crashed"] += 1
+    assert crash.fired and crash.fired_seam == "pool_window@doomed"
+    assert statuses["crashed"] > 0
+    assert tier.pool_crashes == 1
+    assert doomed.frontend._state == "failed"
+    # both workers outlived the crash: the sibling still applies
+    for j in range(5):
+        assert sib.submit(
+            s_src, lines_batch(f"after{j}")).result(10).applied
+    with pytest.raises(FrontendClosed):
+        doomed.submit(d_src, lines_batch("dead"))
+    tier.unregister("doomed", flush=False)
+    tier.close()
+
+
+def test_scoped_pump_seam_crashes_one_graph_mid_window():
+    crash = CrashInjector(at=1, only="pump_before_tick@doomed")
+    tier = ServeTier(max_bytes=8 << 20, pump_threads=2, crash=crash)
+    d_sched, d_src, _ = make_graph()
+    doomed = tier.register("doomed", d_sched, config())
+    s_sched, s_src, _ = make_graph()
+    sib = tier.register("sib", s_sched, config())
+    t = doomed.submit(d_src, lines_batch("x"))
+    with pytest.raises(PumpCrashed):
+        t.result(timeout=10)
+    assert crash.fired_seam == "pump_before_tick@doomed"
+    assert sib.submit(s_src, lines_batch("fine")).result(10).applied
+    tier.unregister("doomed", flush=False)
+    tier.close()
+
+
+def test_durable_graph_in_tier_recovers_exactly_once(tmp_path):
+    wal_dir = str(tmp_path / "wal")
+    crash = CrashInjector(at=2, only="pump_before_tick@wal")
+    tier = ServeTier(max_bytes=8 << 20, pump_threads=2, crash=crash)
+    g, src, sink = wordcount.build_graph()
+    dsched = DurableScheduler(g, wal_dir=wal_dir, fsync="record")
+    h = tier.register("wal", dsched, config())
+    sent = [(f"m{j}", lines_batch(f"w{j % 4}", "c")) for j in range(30)]
+    tks = []
+    for bid, batch in sent:
+        try:
+            tks.append(h.submit(src, batch, batch_id=bid))
+        except FrontendClosed:
+            break
+        time.sleep(0.001)  # several windows
+    crashed = 0
+    for t in tks:
+        try:
+            t.result(timeout=10)
+        except PumpCrashed:
+            crashed += 1
+    assert crash.fired and crashed > 0
+    tier.unregister("wal", flush=False)
+    tier.close()
+
+    # recover into a fresh tier and re-send EVERY id: exactly-once
+    g2, src2, sink2 = wordcount.build_graph()
+    rsched = DurableScheduler(g2, wal_dir=wal_dir, fsync="record")
+    recover(rsched, wal_dir)
+    tier2 = ServeTier(max_bytes=8 << 20, pump_threads=2)
+    h2 = tier2.register("wal", rsched, config())
+    results = [h2.submit(src2, batch, batch_id=bid).result(10)
+               for bid, batch in sent]
+    h2.flush(timeout=10)
+    assert any(r.status == "deduped" for r in results)
+    want_sched, want_src, want_sink = make_graph()
+    for _bid, batch in sent:
+        want_sched.push(want_src, batch)
+        want_sched.tick()
+    assert dict(rsched.view(sink2.name)) == dict(
+        want_sched.view(want_sink.name))
+    tier2.close()
+
+
+# -- metrics -----------------------------------------------------------------
+
+def test_tier_metrics_and_json_round_trip(tmp_path):
+    tier = ServeTier(max_bytes=1 << 20, pump_threads=2)
+    sched, src, sink = make_graph()
+    h = tier.register("g", sched, config(weight=2.0, floor_bytes=1024))
+    for j in range(20):
+        h.submit(src, lines_batch(f"w{j % 3}")).result(10)
+    h.flush(timeout=10)
+    tm = summarize_tier(tier)
+    assert tm.graphs == 1 and tm.pump_threads == 2
+    assert tm.windows >= 1 and tm.pool_crashes == 0
+    assert 0.0 <= tm.pump_utilization <= 1.0
+    assert tm.budget_total_bytes == 1 << 20
+    assert tm.budget_peak_bytes > 0
+    g = tm.per_graph["g"]
+    assert g["weight"] == 2.0 and g["floor_bytes"] == 1024
+    assert g["applied"] == 20 and g["state"] == "running"
+    assert g["windows"] >= 1 and g["rows_applied"] > 0
+    # every export survives json round-trip (numpy scalars coerced)
+    for payload in (tm.to_dict(), summarize_serve(h.frontend).to_dict()):
+        assert json.loads(json.dumps(payload)) == payload
+    wal = WriteAheadLog(str(tmp_path), fsync="record")
+    wal.append({"kind": "tick", "tick": 0})
+    wal.close()
+    wm = summarize_wal(wal).to_dict()
+    assert json.loads(json.dumps(wm)) == wm
+    tier.close()

@@ -406,6 +406,62 @@ def test_group_commit_survives_rotation(tmp_path):
     assert [r["tick"] for _p, r in records] == list(range(64))
 
 
+def test_append_group_rotation_mid_window_atomic_replay(tmp_path):
+    """A coalesced macro-tick whose ``append_group`` starts in one
+    segment and rotates mid-window: the sealed segment must be fsynced
+    AT the rotation (even under the lazy ``"tick"`` policy — the crash
+    here dies before any tick marker, so rotation is the only barrier),
+    and every ``batch_ids`` replay unit must stay all-or-nothing across
+    the segment boundary."""
+    wal_dir = str(tmp_path / "wal")
+    g, src, sink = wordcount.build_graph()
+    crash = CrashInjector(at=1, only="after_append")
+    sched = DurableScheduler(g, wal_dir=wal_dir, fsync="tick",
+                             segment_bytes=1024, crash=crash)
+    feeds, feed_ids = [], []
+    for t in range(8):
+        lines = [" ".join(f"w{(t * 7 + k) % 13}" for k in range(40))]
+        feeds.append({src: wordcount.ingest_lines(lines)})
+        feed_ids.append({src: [f"t{t}a", f"t{t}b"]})
+    with pytest.raises(CrashPoint):
+        sched.tick_many(feeds, feed_ids=feed_ids)
+    segs = list_segments(wal_dir)
+    assert len(segs) > 1, "window did not span a rotation; shrink segments"
+    # the "tick" policy alone would have fsynced NOTHING yet (no tick
+    # mark was reached): every fsync on the books is a rotation sealing
+    # a full segment
+    assert sched.wal.fsyncs == len(segs) - 1
+    records, torn = scan_wal(wal_dir)
+    assert torn is None and len(records) == 8
+
+    g2, src2, sink2 = wordcount.build_graph()
+    fresh = DurableScheduler(g2, wal_dir=wal_dir, fsync="tick")
+    report = recover(fresh, wal_dir)
+    # the crash died before execution, so no tick marker landed: the
+    # replayed units sit as pending backlog until the next tick
+    fresh.tick()
+    fresh.close()
+    assert report.replayed_pushes == 8
+    g3, src3, sink3 = wordcount.build_graph()
+    want = DirtyScheduler(g3)
+    for feed in feeds:
+        for _src, batch in feed.items():
+            want.push(src3, batch)
+        want.tick()
+    assert dict(fresh.view(sink2.name)) == dict(want.view(sink3.name))
+
+    # all-or-nothing across the boundary: pre-seeding ONE id of a
+    # mid-log unit dedups that whole unit and only it
+    g4, src4, sink4 = wordcount.build_graph()
+    again = DurableScheduler(g4, wal_dir=wal_dir, fsync="tick")
+    again._register_batch_id("t4a")
+    report2 = recover(again, wal_dir)
+    again.tick()
+    again.close()
+    assert report2.replayed_pushes == 7
+    assert report2.deduped_pushes == 1
+
+
 def test_empty_group_is_a_noop(tmp_path):
     wal = WriteAheadLog(str(tmp_path), fsync="record")
     fsyncs0 = wal.fsyncs

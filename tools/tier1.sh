@@ -22,7 +22,17 @@ timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
   2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
-echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+echo DOTS_PASSED=$dots
+
+# regression floor: the suite passed 242 at the PR-3 baseline; a run
+# below that means previously-green tests broke (or silently vanished),
+# even if pytest's own exit status reads clean.
+FLOOR=${TIER1_FLOOR:-242}
+if [ "$dots" -lt "$FLOOR" ]; then
+  echo "TIER1: DOTS_PASSED=$dots below floor $FLOOR"
+  rc=4
+fi
 
 # optional (RUN_BENCH=1): the serve-mode smoke — sustained ingestion
 # throughput must coalesce (>1 micro-batch/tick at 16 producers) with
@@ -37,6 +47,24 @@ assert r["coalesce_gt_1_at_16p"], r
 assert r["zero_forced_syncs"], r
 print(f"TIER1 serve smoke: {r['serve_16p_rows_per_s']} rows/s @16p, "
       f"coalesce {r['serve_16p_coalesce_factor']}x")
+EOF
+fi
+
+# optional (RUN_BENCH=1): the tier-mode smoke — 4 graphs x 4 producers
+# on a 2-thread pump pool: zero forced syncs, pump-crash isolation with
+# exactly-once recovery, and a bounded quiet-tenant admission p99.
+if [ "${RUN_BENCH:-0}" = "1" ] && [ $rc -eq 0 ]; then
+  REFLOW_BENCH_TIER=1 REFLOW_BENCH_SMOKE=1 JAX_PLATFORMS=cpu \
+    timeout -k 10 300 python bench.py > /tmp/_t1_tier.json || rc=3
+  python - <<'EOF' || rc=3
+import json
+r = json.load(open("/tmp/_t1_tier.json"))
+assert r["zero_forced_syncs"], r
+assert r["crash_exactly_once"], r
+assert r["quiet_p99_bounded"], r
+print(f"TIER1 tier smoke: {r['tier_rows_per_s_4g_2threads']} rows/s "
+      f"(4g, 2 threads), crash isolation ok, quiet p99 "
+      f"{r['quiet_admission_p99_us']}us")
 EOF
 fi
 exit $rc

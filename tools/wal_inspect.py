@@ -32,8 +32,19 @@ def _describe(rec: dict) -> str:
     kind = rec.get("kind", "?")
     if kind == "push":
         w = np.asarray(rec["weights"])
+        ids = rec.get("batch_ids")
+        if ids:
+            # a coalesced frontend feed batch (wal/durable.tick_many):
+            # these micro-batch ids are ONE replay unit — recovery
+            # re-folds all of them or dedups all of them, never a subset
+            shown = ", ".join(repr(i) for i in ids[:3])
+            if len(ids) > 3:
+                shown += f", … +{len(ids) - 3} more"
+            idpart = f"ids[{len(ids)} coalesced, atomic]=[{shown}]"
+        else:
+            idpart = f"id={rec['batch_id']!r}"
         return (f"push  tick={rec['tick']:<6} src={rec['node_name']!r}"
-                f"(#{rec['node']}) id={rec['batch_id']!r} rows={len(w)} "
+                f"(#{rec['node']}) {idpart} rows={len(w)} "
                 f"net_weight={int(w.sum())}")
     if kind == "tick":
         return f"tick  tick={rec['tick']}"
@@ -48,14 +59,35 @@ def inspect(wal_dir: str, *, verbose: bool = True) -> dict:
     records, torn = scan_wal(wal_dir)
     counts: dict = {}
     rows = ticks = 0
+    # group-commit shape: a coalesced frontend window is appended as one
+    # run of push records between tick marks (durable.tick_many), so the
+    # on-disk commit-window sizes are the push-run lengths; replay units
+    # are the per-record batch_ids lists (atomic: all folded or all
+    # deduped)
+    coalesced_records = coalesced_ids = max_ids = 0
+    push_runs: list = []
+    run = 0
     for pos, rec in records:
-        counts[rec.get("kind", "?")] = counts.get(rec.get("kind", "?"), 0) + 1
-        if rec.get("kind") == "push":
+        kind = rec.get("kind", "?")
+        counts[kind] = counts.get(kind, 0) + 1
+        if kind == "push":
             rows += len(np.asarray(rec["weights"]))
-        if rec.get("kind") == "tick":
+            run += 1
+            ids = rec.get("batch_ids")
+            if ids:
+                coalesced_records += 1
+                coalesced_ids += len(ids)
+                max_ids = max(max_ids, len(ids))
+        else:
+            if run:
+                push_runs.append(run)
+            run = 0
+        if kind == "tick":
             ticks = max(ticks, rec["tick"])
         if verbose:
             print(f"  {pos.segment:08d}:{pos.offset:<10} {_describe(rec)}")
+    if run:
+        push_runs.append(run)
     return {
         "wal_dir": wal_dir,
         "segments": len(segs),
@@ -64,6 +96,11 @@ def inspect(wal_dir: str, *, verbose: bool = True) -> dict:
         "record_kinds": counts,
         "push_rows": rows,
         "last_tick_mark": ticks,
+        "coalesced_push_records": coalesced_records,
+        "coalesced_micro_batches": coalesced_ids,
+        "max_replay_unit_ids": max_ids,
+        "commit_windows": len(push_runs),
+        "commit_window_max_pushes": max(push_runs) if push_runs else 0,
         "torn_tail": torn._asdict() if torn is not None else None,
     }
 
@@ -89,6 +126,15 @@ def main(argv=None) -> int:
               f"record(s), {summary['bytes']} bytes; kinds="
               f"{summary['record_kinds']} push_rows={summary['push_rows']} "
               f"last_tick_mark={summary['last_tick_mark']}")
+        if summary["coalesced_push_records"]:
+            print(f"coalesced group-commit: "
+                  f"{summary['coalesced_push_records']} record(s) "
+                  f"carrying {summary['coalesced_micro_batches']} "
+                  f"micro-batch ids (largest replay unit "
+                  f"{summary['max_replay_unit_ids']}); "
+                  f"{summary['commit_windows']} commit window(s), "
+                  f"largest {summary['commit_window_max_pushes']} "
+                  f"push(es)")
         if torn:
             print(f"torn tail (tolerated): segment {torn['segment']} @ "
                   f"{torn['offset']}: {torn['reason']}")
