@@ -65,10 +65,23 @@ Env knobs::
                                   recover) and hot/quiet-tenant QoS
                                   isolation (CPU-only, no tunnel)
     REFLOW_BENCH_TIER_BATCHES     micro-batches per producer (default 200)
+    REFLOW_BENCH_OBS=1            obs mode instead: tracing + telemetry
+                                  overhead on the 16-producer serve
+                                  protocol over a durable scheduler, obs
+                                  disabled vs enabled, plus the chrome
+                                  trace export and the per-ticket stage
+                                  decomposition check (CPU-only, no tunnel)
+    REFLOW_BENCH_OBS_BATCHES      micro-batches per producer (default 250)
+    REFLOW_TRACE_OUT              obs-mode chrome trace path
+                                  (default /tmp/reflow_obs_trace.json)
+
+Every mode also accepts ``--json-out PATH``: the final result object is
+written there (pretty-printed) in addition to the stdout JSON line.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import subprocess
@@ -326,6 +339,139 @@ def run_serve_bench() -> dict:
     out["coalesce_gt_1_at_16p"] = out["serve_16p_coalesce_factor"] > 1.0
     out["zero_forced_syncs"] = all(
         out[f"serve_{n}p_forced_syncs"] == 0 for n in (1, 4, 16))
+    from reflow_tpu import obs
+    if obs.enabled():
+        # REFLOW_TRACE=1 at bench time: export what the run recorded
+        out["trace_file"] = obs.export_chrome_trace()
+        log(f"serve: chrome trace -> {out['trace_file']}")
+    return out
+
+
+# -- obs / tracing-overhead mode (REFLOW_BENCH_OBS=1) ----------------------
+
+def run_obs_bench() -> dict:
+    """Observability-overhead numbers (docs/guide.md "Observability"):
+    the 16-producer serve protocol from ``run_serve_bench`` driven over
+    a ``DurableScheduler`` (``fsync="record"``, so the per-ticket fsync
+    stage is real work), run twice — obs fully disabled, then with
+    tracing enabled plus a live ``MetricsRegistry`` and a fast-interval
+    ``SnapshotEmitter``. Reports the throughput overhead fraction
+    (acceptance: <3% enabled, <1% merely importable), exports the
+    chrome trace, and checks the per-ticket stage decomposition: each
+    sampled ticket's six stage durations must sum to within 10% of its
+    measured end-to-end latency.
+
+    Host-side CPU work; no tunnel protocol applies.
+    """
+    import shutil
+    import tempfile
+    import threading
+
+    from reflow_tpu import obs
+    from reflow_tpu.serve import CoalesceWindow, IngestFrontend
+    from reflow_tpu.wal import DurableScheduler
+    from reflow_tpu.workloads import wordcount
+
+    smoke = os.environ.get("REFLOW_BENCH_SMOKE") == "1"
+    per_producer = int(os.environ.get(
+        "REFLOW_BENCH_OBS_BATCHES", "40" if smoke else "250"))
+    rows_per_batch = 8
+    n_prod = 16
+
+    def make_lines(producer: int, j: int) -> list:
+        rng = np.random.default_rng(producer * 100_003 + j)
+        return [" ".join(f"w{int(x)}"
+                         for x in rng.integers(0, 1000, rows_per_batch))]
+
+    def run_once(wal_dir: str, registry=None) -> float:
+        g, src, _sink = wordcount.build_graph()
+        sched = DurableScheduler(g, wal_dir=wal_dir, fsync="record")
+        fe = IngestFrontend(sched, window=CoalesceWindow(
+            max_rows=4096, max_ticks=8, max_latency_s=0.005))
+        if registry is not None:
+            fe.publish_metrics(registry)
+            sched.publish_metrics(registry)
+            sched.wal.publish_metrics(registry)
+        tickets = []
+        tk_lock = threading.Lock()
+
+        def produce(pid, fe=fe, src=src):
+            mine = [fe.submit(src, wordcount.ingest_lines(
+                make_lines(pid, j))) for j in range(per_producer)]
+            with tk_lock:
+                tickets.extend(mine)
+
+        threads = [threading.Thread(target=produce, args=(pid,))
+                   for pid in range(n_prod)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        fe.flush()
+        wall = time.perf_counter() - t0
+        assert all(t.result(timeout=30).applied for t in tickets)
+        fe.close()
+        sched.wal.close()
+        return n_prod * per_producer * rows_per_batch / wall
+
+    out = {"per_producer_batches": per_producer,
+           "rows_per_batch": rows_per_batch, "producers": n_prod}
+    tmp = tempfile.mkdtemp(prefix="reflow-obs-bench-")
+    try:
+        obs.disable()
+        obs.trace.reset()
+        rate_off = run_once(os.path.join(tmp, "wal-off"))
+        out["disabled_rows_per_s"] = round(rate_off)
+        log(f"obs[off]: {rate_off:.0f} rows/s")
+
+        obs.trace.reset()
+        obs.enable()
+        reg = obs.MetricsRegistry()
+        snap_path = os.path.join(tmp, "snapshots.jsonl")
+        emitter = obs.SnapshotEmitter(snap_path, interval_s=0.2,
+                                      registry=reg)
+        emitter.start()
+        try:
+            rate_on = run_once(os.path.join(tmp, "wal-on"), registry=reg)
+        finally:
+            emitter.stop()
+            obs.disable()
+        out["enabled_rows_per_s"] = round(rate_on)
+        overhead = 1.0 - rate_on / rate_off
+        out["obs_overhead_frac"] = round(overhead, 4)
+        out["obs_overhead_lt_3pct"] = overhead < 0.03
+        log(f"obs[on]: {rate_on:.0f} rows/s "
+            f"(overhead {100 * overhead:.2f}%)")
+
+        with open(snap_path) as f:
+            snaps = [json.loads(ln) for ln in f if ln.strip()]
+        out["snapshot_lines"] = len(snaps)
+        out["snapshot_schema_ok"] = bool(snaps) and all(
+            s.get("schema") == obs.SNAPSHOT_SCHEMA for s in snaps)
+
+        # export + decomposition check on the enabled run's rings
+        events = obs.chrome_events()
+        trace_path = os.environ.get("REFLOW_TRACE_OUT",
+                                    "/tmp/reflow_obs_trace.json")
+        obs.export_chrome_trace(trace_path)
+        out["trace_file"] = trace_path
+        out["trace_events"] = sum(1 for e in events if e.get("ph") == "X")
+        timelines = obs.ticket_timelines(events)
+        out["sampled_tickets"] = len(timelines)
+        max_dev = 0.0
+        for t in timelines.values():
+            if t["e2e_us"] > 0:
+                max_dev = max(max_dev, abs(t["sum_us"] - t["e2e_us"])
+                              / t["e2e_us"])
+        out["decomposition_max_dev_frac"] = round(max_dev, 4)
+        out["decomposition_ok"] = bool(timelines) and max_dev <= 0.10
+        log(f"obs: {out['trace_events']} spans, "
+            f"{len(timelines)} sampled tickets, stage-sum deviation max "
+            f"{100 * max_dev:.2f}% -> {trace_path}")
+        obs.trace.reset()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
     return out
 
 
@@ -877,41 +1023,70 @@ def _spawn(name: str) -> dict:
             "stdout_tail": lines[-3:]}
 
 
+def _emit(result: dict, json_out=None) -> None:
+    """Print the final result as the one parseable stdout line; when
+    ``--json-out`` was given, also write it there pretty-printed (the
+    machine-comparison artifact — stdout stays the contract)."""
+    print(json.dumps(result))
+    if json_out:
+        with open(json_out, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+            f.write("\n")
+        log(f"result written to {json_out}")
+
+
 def main() -> None:
+    ap = argparse.ArgumentParser(add_help=False)
+    ap.add_argument("--json-out", default=None, metavar="PATH")
+    cli, _ = ap.parse_known_args()
+    json_out = cli.json_out
+
     if os.environ.get("REFLOW_BENCH_TIER") == "1":
         # tier mode is host-side CPU work — no tunnel, no subprocesses
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         out = run_tier_bench()
-        print(json.dumps({
+        _emit({
             "metric": "tier_rows_per_s_4g_2threads",
             "value": out["tier_rows_per_s_4g_2threads"],
             "unit": "rows/s",
             **out,
-        }))
+        }, json_out)
         return
 
     if os.environ.get("REFLOW_BENCH_SERVE") == "1":
         # serve mode is host-side CPU work — no tunnel, no subprocesses
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         out = run_serve_bench()
-        print(json.dumps({
+        _emit({
             "metric": "serve_ingest_rows_per_s_16_producers",
             "value": out["serve_16p_rows_per_s"],
             "unit": "rows/s",
             **out,
-        }))
+        }, json_out)
+        return
+
+    if os.environ.get("REFLOW_BENCH_OBS") == "1":
+        # obs mode is host-side CPU work — no tunnel, no subprocesses
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        out = run_obs_bench()
+        _emit({
+            "metric": "serve_obs_overhead_frac",
+            "value": out["obs_overhead_frac"],
+            "unit": "frac",
+            **out,
+        }, json_out)
         return
 
     if os.environ.get("REFLOW_BENCH_RECOVERY") == "1":
         # WAL mode is host-side CPU work — no tunnel, no subprocesses
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         out = run_recovery_bench()
-        print(json.dumps({
+        _emit({
             "metric": "wal_recovery_time_to_first_tick_s",
             "value": out["time_to_first_tick_s"],
             "unit": "s",
             **out,
-        }))
+        }, json_out)
         return
 
     child = os.environ.get("REFLOW_BENCH_CHILD")
@@ -940,12 +1115,12 @@ def main() -> None:
     tpu = _spawn("pr_tpu")
     log("tpu:", json.dumps(tpu))
     if "error" in tpu:
-        print(json.dumps({
+        _emit({
             "metric": ("pagerank_incremental_delta_ops_per_s_speedup"
                        "_vs_cpu_executor"),
             "value": 0.0, "unit": "x", "vs_baseline": 0.0,
             "error": tpu["error"],
-        }))
+        }, json_out)
         return
     # the deferred window (cross-tick residual deferral, defer_passes):
     # the incr_vs_full lever, with its accuracy contract measured in the
@@ -1009,7 +1184,7 @@ def main() -> None:
     log("cpu:", json.dumps(cpu))
 
     speedup = tpu["delta_ops_per_s"] / cpu["delta_ops_per_s"]
-    print(json.dumps({
+    _emit({
         "metric": "pagerank_incremental_delta_ops_per_s_speedup_vs_cpu_executor",
         "value": round(speedup, 2),
         "unit": "x",
@@ -1037,7 +1212,7 @@ def main() -> None:
                 tpud.get("drained_max_rel_err"),
             "quiescent_max_rel_err":
                 tpu.get("max_rel_err_vs_reference")} if tpud else {}),
-    }))
+    }, json_out)
 
 
 if __name__ == "__main__":

@@ -8,11 +8,13 @@ differentially tested against and benchmarked against (north star: ≥20×).
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Sequence
 
 from reflow_tpu.delta import DeltaBatch
 from reflow_tpu.executors.base import Executor
 from reflow_tpu.graph import Node
+from reflow_tpu.obs import trace as _trace
 
 __all__ = ["CpuExecutor"]
 
@@ -22,6 +24,7 @@ class CpuExecutor(Executor):
 
     def run_pass(self, plan: Sequence[Node],
                  ingress: Dict[int, DeltaBatch]) -> Dict[int, DeltaBatch]:
+        t0 = time.perf_counter() if _trace.ENABLED else 0.0
         outputs: Dict[int, DeltaBatch] = {}
         egress: Dict[int, DeltaBatch] = {}
         for node in plan:
@@ -45,4 +48,7 @@ class CpuExecutor(Executor):
                 back = outputs[loop.back_input.id].consolidate()
                 if len(back):
                     egress[loop.id] = back
+        if _trace.ENABLED:
+            _trace.evt("cpu_pass", t0, time.perf_counter() - t0,
+                       args={"nodes": len(plan)})
         return egress

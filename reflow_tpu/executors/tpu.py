@@ -16,6 +16,7 @@ signature, so steady-state ticks hit the cache and pay zero tracing cost.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -28,6 +29,7 @@ from reflow_tpu.executors.device_delta import (DeviceDelta, bucket_capacity,
 from reflow_tpu.executors.lowerings import (DEVICE_REDUCERS, join_state,
                                             lower_node, reduce_state)
 from reflow_tpu.graph import FlowGraph, GraphError, Node
+from reflow_tpu.obs import trace as _trace
 
 __all__ = ["TpuExecutor"]
 
@@ -241,8 +243,13 @@ class TpuExecutor(Executor):
                 list(st.exit_plan),
                 {n.id: 2 * n.inputs[0].spec.key_space for n in st.boundary})
 
+        t_d0 = time.perf_counter() if _trace.ENABLED else 0.0
         new_states, sink_egress, carry, iters, rows, converged = prog(
             dict(self.states), dev_ingress)
+        if _trace.ENABLED:
+            _trace.evt("device_dispatch", t_d0,
+                       time.perf_counter() - t_d0,
+                       args={"kind": "fixpoint"})
         self.states = new_states
         exit_passes = 1 if st.exit_plan else 0
         leftover = {}
@@ -325,7 +332,12 @@ class TpuExecutor(Executor):
             # loop-free sink-free graph (e.g. streaming TF-IDF): scan the
             # PLAIN pass program over the K stacked feeds — one device
             # execution for K ticks, zero per-tick egress by construction
+            t_h0 = time.perf_counter() if _trace.ENABLED else 0.0
             stack, caps = self._stack_feeds(feeds)
+            if _trace.ENABLED:
+                _trace.evt("stack_feeds", t_h0,
+                           time.perf_counter() - t_h0,
+                           args={"ticks": K})
             sig = ("pass_many", tuple(n.id for n in plan),
                    tuple(sorted(caps.items())))
             prog = self._cache.get(sig)
@@ -345,7 +357,12 @@ class TpuExecutor(Executor):
                 prog = jax.jit(scan_fn, donate_argnums=0)
                 self._cache[sig] = prog
             self._track_arena(plan, caps)
+            t_d0 = time.perf_counter() if _trace.ENABLED else 0.0
             self.states = prog(dict(self.states), stack)
+            if _trace.ENABLED:
+                _trace.evt("device_dispatch", t_d0,
+                           time.perf_counter() - t_d0,
+                           args={"kind": "pass_many", "ticks": K})
             return K, 0, 0, True, set()
 
         if self._fx_unsupported:
@@ -356,7 +373,11 @@ class TpuExecutor(Executor):
                 self._fx_unsupported = True
                 return None
 
+        t_h0 = time.perf_counter() if _trace.ENABLED else 0.0
         stack, caps = self._stack_feeds(feeds)
+        if _trace.ENABLED:
+            _trace.evt("stack_feeds", t_h0, time.perf_counter() - t_h0,
+                       args={"ticks": K})
         sig = ("fx", tuple(n.id for n in plan),
                tuple(sorted(caps.items())), max_iters)
         prog = self._cache.get(sig)
@@ -375,8 +396,13 @@ class TpuExecutor(Executor):
                 list(st.exit_plan),
                 {n.id: 2 * n.inputs[0].spec.key_space for n in st.boundary})
 
+        t_d0 = time.perf_counter() if _trace.ENABLED else 0.0
         new_states, (iters, rows, conv) = prog.call_many(
             dict(self.states), stack, K)
+        if _trace.ENABLED:
+            _trace.evt("device_dispatch", t_d0,
+                       time.perf_counter() - t_d0,
+                       args={"kind": "fixpoint_many", "ticks": K})
         self.states = new_states
         extra_dirty = set(st.region_ids) | {n.id for n in st.exit_plan}
         passes_base = K * (1 + (1 if st.exit_plan else 0))

@@ -1,0 +1,36 @@
+"""reflow_tpu.obs — tracing + live metrics for the serving stack.
+
+Two halves, one import:
+
+- **Trace spans** (:mod:`.trace` / :mod:`.export`): per-thread ring
+  buffers of timed stage spans, off by default (``REFLOW_TRACE=1`` or
+  :func:`enable`), exported as Chrome trace-event JSON for Perfetto.
+  Sampled tickets get a six-stage end-to-end timeline (admission /
+  coalesce / sched_delay / execute / fsync / resolve) that tiles the
+  measured ticket latency exactly.
+- **Live registry** (:mod:`.registry`): named counters/gauges plus
+  ``register_source`` bridges to the existing ``summarize_*().to_dict()``
+  schemas; :class:`SnapshotEmitter` appends periodic JSONL snapshots.
+
+Quickstart::
+
+    from reflow_tpu import obs
+    obs.enable()                       # or REFLOW_TRACE=1
+    fe.publish_metrics()               # frontend/tier/wal/sched/budget
+    with obs.SnapshotEmitter("telemetry.jsonl", interval_s=2.0):
+        ...serve traffic...
+    obs.export_chrome_trace("trace.json")   # open in ui.perfetto.dev
+"""
+
+from . import export, registry, trace  # noqa: F401
+from .export import chrome_events, export_chrome_trace, ticket_timelines
+from .registry import (REGISTRY, SNAPSHOT_SCHEMA, Counter, Gauge,
+                       MetricsRegistry, SnapshotEmitter)
+from .trace import (STAGES, TraceCtx, disable, enable, enabled, evt,
+                    mint, ticket_stages)
+
+__all__ = ["chrome_events", "export_chrome_trace", "ticket_timelines",
+           "REGISTRY", "SNAPSHOT_SCHEMA", "Counter", "Gauge",
+           "MetricsRegistry", "SnapshotEmitter", "STAGES", "TraceCtx",
+           "disable", "enable", "enabled", "evt", "mint",
+           "ticket_stages"]

@@ -1,0 +1,201 @@
+"""Trace spans: lock-free per-thread ring buffers of timed events.
+
+The serving stack mints a :class:`TraceCtx` at ``IngestFrontend.submit``
+and carries it on the :class:`~reflow_tpu.serve.tickets.Ticket`; each
+subsystem a ticket crosses (admission, coalesce queue, pump/tick, WAL
+group-commit, resolve) records stage spans via :func:`evt`. Events land
+in a fixed-size ring owned by the *recording* thread — no locks, no
+allocation beyond the event tuple — so tracing a hot pump costs one
+attribute check when disabled and one ring slot when enabled.
+
+Disabled by default. Enable with ``REFLOW_TRACE=1`` in the environment
+or ``obs.enable()`` at runtime; every instrumentation site guards with
+a direct ``if trace.ENABLED:`` module-attribute read so the disabled
+cost stays at a single dict lookup (the <1% serve-bench regression
+budget in ISSUE 4).
+
+Per-ticket sampling: minting is counted globally and every
+``SAMPLE_EVERY``-th ticket (``REFLOW_TRACE_SAMPLE``, default 16) gets
+``sampled=True`` — only sampled tickets emit the six-stage end-to-end
+timeline (:func:`ticket_stages`); unsampled traffic still appears in
+the aggregate per-thread spans (windows, ticks, WAL appends).
+
+The stage tiling is exact by construction: ``admission`` ``[t0,t_adm]``,
+``coalesce`` ``[t_adm,t_ready]``, ``sched_delay`` ``[t_ready,t_exec0]``,
+``execute`` ``[t_exec0,t_exec1-wal_s]``, ``fsync`` ``[t_exec1-wal_s,
+t_exec1]``, ``resolve`` ``[t_exec1,t_res]`` — the six durations tile
+``[t0,t_res]`` with no gaps or overlap, so they sum to the measured
+end-to-end ticket latency (the 10% acceptance budget is headroom for
+export rounding, not for model error). ``wal_s`` is gathered by a
+thread-local accumulator the WAL feeds during ``append_group``/fsync on
+the pump thread, letting the frontend subtract durable-log time out of
+the execute span it straddles.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["ENABLED", "RING_CAPACITY", "SAMPLE_EVERY", "STAGES",
+           "TraceCtx", "enable", "disable", "enabled", "reset", "evt",
+           "mint", "ticket_stages", "wal_accum_reset", "wal_accum_add",
+           "wal_accum_take"]
+
+#: hot-path gate — read directly (``if trace.ENABLED:``) at every
+#: instrumentation site; never wrapped in a function call
+ENABLED = False
+
+RING_CAPACITY = int(os.environ.get("REFLOW_TRACE_RING", "65536"))
+SAMPLE_EVERY = max(1, int(os.environ.get("REFLOW_TRACE_SAMPLE", "16")))
+
+#: the per-ticket stage names, in pipeline order
+STAGES = ("admission", "coalesce", "sched_delay", "execute", "fsync",
+          "resolve")
+
+#: event tuple: (name, ts_s, dur_s, track_override_or_None, args_or_None)
+Event = Tuple[str, float, float, Optional[str], Optional[Dict[str, Any]]]
+
+_rings: List["Ring"] = []
+_rings_lock = threading.Lock()  # ring *registration* only, never puts
+_tls = threading.local()
+_gen = 0
+_mint_n = itertools.count()
+
+
+class TraceCtx:
+    """Per-submission trace context carried on the Ticket."""
+
+    __slots__ = ("batch_id", "t0", "sampled")
+
+    def __init__(self, batch_id: str, t0: float, sampled: bool):
+        self.batch_id = batch_id
+        self.t0 = t0
+        self.sampled = sampled
+
+
+class Ring:
+    """Fixed-size overwrite-oldest event buffer, single-writer (the
+    owning thread); snapshots tolerate concurrent writes by copying."""
+
+    __slots__ = ("track", "cap", "buf", "n", "gen")
+
+    def __init__(self, track: str, cap: int, gen: int):
+        self.track = track
+        self.cap = cap
+        self.buf: List[Optional[Event]] = [None] * cap
+        self.n = 0
+        self.gen = gen
+
+    def put(self, ev: Event) -> None:
+        self.buf[self.n % self.cap] = ev
+        self.n += 1
+
+    def events(self) -> List[Event]:
+        """Buffered events, oldest first (an approximate snapshot if the
+        owner is still writing — fine for export)."""
+        n, cap = self.n, self.cap
+        if n <= cap:
+            return [e for e in self.buf[:n] if e is not None]
+        i = n % cap
+        return [e for e in self.buf[i:] + self.buf[:i] if e is not None]
+
+
+def _ring() -> Ring:
+    r = getattr(_tls, "ring", None)
+    if r is None or r.gen != _gen:
+        r = Ring(threading.current_thread().name, RING_CAPACITY, _gen)
+        _tls.ring = r
+        with _rings_lock:
+            _rings.append(r)
+    return r
+
+
+def enable() -> None:
+    """Turn tracing on (idempotent)."""
+    global ENABLED
+    ENABLED = True
+
+
+def disable() -> None:
+    global ENABLED
+    ENABLED = False
+
+
+def enabled() -> bool:
+    return ENABLED
+
+
+def reset() -> None:
+    """Drop all buffered events and detach every thread's ring (they
+    re-register lazily via a generation bump). Tests / bench baselines."""
+    global _gen
+    with _rings_lock:
+        _gen += 1
+        _rings.clear()
+
+
+def evt(name: str, ts: float, dur: float, track: Optional[str] = None,
+        args: Optional[Dict[str, Any]] = None) -> None:
+    """Record one complete span: ``ts`` is a ``time.perf_counter()``
+    start, ``dur`` seconds. ``track`` overrides the export row (default:
+    the recording thread's name)."""
+    if not ENABLED:
+        return
+    _ring().put((name, ts, dur, track, args))
+
+
+def mint(batch_id: str, t0: float) -> TraceCtx:
+    """Mint the trace context for one submission (call under ENABLED)."""
+    return TraceCtx(batch_id, t0,
+                    next(_mint_n) % SAMPLE_EVERY == 0)
+
+
+def ticket_stages(ctx: TraceCtx, *, t_adm: float, t_ready: float,
+                  t_exec0: float, t_exec1: float, wal_s: float,
+                  t_res: float) -> None:
+    """Emit the six-stage end-to-end timeline of one sampled ticket onto
+    its own ``ticket/<batch_id>`` track. Boundaries are clamped into
+    pipeline order so the stages tile ``[ctx.t0, t_res]`` exactly."""
+    if not ENABLED:
+        return
+    track = f"ticket/{ctx.batch_id}"
+    t_adm = max(ctx.t0, min(t_adm, t_exec0))
+    c1 = max(t_adm, min(t_ready, t_exec0))      # coalesce end
+    w = max(0.0, min(wal_s, t_exec1 - t_exec0))  # fsync share of execute
+    e1 = t_exec1 - w                            # execute end
+    spans = (("admission", ctx.t0, t_adm),
+             ("coalesce", t_adm, c1),
+             ("sched_delay", c1, t_exec0),
+             ("execute", t_exec0, e1),
+             ("fsync", e1, t_exec1),
+             ("resolve", t_exec1, max(t_exec1, t_res)))
+    ring = _ring()
+    for name, s, e in spans:
+        ring.put((name, s, e - s, track, {"batch_id": ctx.batch_id}))
+
+
+# -- WAL time accumulator ----------------------------------------------------
+# append_group/fsync run on the pump thread *inside* the frontend's
+# execute window; the WAL adds its wall time here so the frontend can
+# carve a distinct fsync stage out of the execute span.
+
+def wal_accum_reset() -> None:
+    _tls.wal_s = 0.0
+
+
+def wal_accum_add(s: float) -> None:
+    _tls.wal_s = getattr(_tls, "wal_s", 0.0) + s
+
+
+def wal_accum_take() -> float:
+    s = getattr(_tls, "wal_s", 0.0)
+    _tls.wal_s = 0.0
+    return s
+
+
+if os.environ.get("REFLOW_TRACE") == "1":
+    enable()

@@ -27,6 +27,7 @@ import numpy as np
 from reflow_tpu.delta import DeltaBatch
 from reflow_tpu.executors import CpuExecutor, Executor
 from reflow_tpu.graph import FlowGraph, GraphError, Node
+from reflow_tpu.obs import trace as _trace
 
 __all__ = ["DirtyScheduler", "TickResult"]
 
@@ -387,6 +388,10 @@ class DirtyScheduler:
             forced_sync=checked and getattr(self.executor, "name",
                                             "") != "cpu",
         )
+        if _trace.ENABLED:
+            _trace.evt("tick", t0, result.wall_s,
+                       args={"tick": self._tick,
+                             "dirty": result.dirty_nodes})
         self.history.append(result)
         return result
 
@@ -467,6 +472,9 @@ class DirtyScheduler:
                     bool(np.asarray(r.quiesced).all()) for r in rs)),
                 _check_errors=self.executor.check_errors,
             )
+            if _trace.ENABLED:
+                _trace.evt("tick_many", t0, agg.wall_s,
+                           args={"ticks": len(feeds), "fused": False})
             self.history.append(agg)
             return agg
 
@@ -487,8 +495,28 @@ class DirtyScheduler:
             quiesced=conv,
             _check_errors=self.executor.check_errors,
         )
+        if _trace.ENABLED:
+            _trace.evt("tick_many", t0, result.wall_s,
+                       args={"ticks": K, "fused": True})
         self.history.append(result)
         return result
+
+    def publish_metrics(self, registry=None, *, name: Optional[str]
+                        = None) -> str:
+        """Register live scheduler gauges (tick horizon, forced syncs,
+        pending pushes) into an obs registry. Gauges only read host
+        counters — never ``summarize(history)``, whose ``block()`` would
+        force device syncs from the telemetry thread. Returns the
+        gauge-name prefix (``sched.<graph>``)."""
+        from reflow_tpu.obs import REGISTRY
+        reg = registry if registry is not None else REGISTRY
+        key = f"sched.{name or self.graph.name}"
+        reg.gauge(f"{key}.tick", lambda: self._tick)
+        reg.gauge(f"{key}.forced_syncs", lambda: self.forced_syncs)
+        reg.gauge(f"{key}.pending_batches",
+                  lambda: sum(len(v) for v in self._pending.values()))
+        reg.gauge(f"{key}.history_len", lambda: len(self.history))
+        return key
 
     def rederive(self, source: Node, batch: DeltaBatch):
         """Invalidate-and-re-derive (the ``refresh_minmax`` pattern

@@ -182,3 +182,24 @@ class AdmissionBudget:
         for share in self._shares.values():
             for cond in share._conds:
                 cond.notify_all()
+
+    # -- observability -----------------------------------------------------
+
+    def publish_metrics(self, registry=None, *, name: str = "budget"
+                        ) -> str:
+        """Register live occupancy gauges (total/used/peak bytes,
+        occupancy fraction, per-share usage) into an obs registry.
+        Gauges read the counters the owning lock already guards —
+        snapshot reads are racy-but-consistent-enough telemetry, never
+        admission decisions. Returns the gauge-name prefix."""
+        from reflow_tpu.obs import REGISTRY
+        reg = registry if registry is not None else REGISTRY
+        reg.gauge(f"{name}.total_bytes", lambda: self.total_bytes)
+        reg.gauge(f"{name}.used_bytes", lambda: self.used)
+        reg.gauge(f"{name}.peak_bytes", lambda: self.peak)
+        reg.gauge(f"{name}.occupancy",
+                  lambda: self.used / self.total_bytes)
+        reg.gauge(f"{name}.per_share_used",
+                  lambda: {s.name: s.used
+                           for s in self._shares.values()})
+        return name

@@ -57,6 +57,7 @@ from collections import deque
 from typing import Deque, Dict, List, Optional
 
 from reflow_tpu.graph import GraphError, Node
+from reflow_tpu.obs import trace as _trace
 from reflow_tpu.scheduler import SourceCursor
 
 from .budget import AdmissionBudget
@@ -142,6 +143,10 @@ class IngestFrontend:
         self.admission_s: Deque[float] = deque(maxlen=METRIC_WINDOW)
         self.ticks_per_pump: Deque[int] = deque(maxlen=METRIC_WINDOW)
         self.inflight_bytes_peak = 0
+        # obs wiring: registered metric sources (publish_metrics) and
+        # the current window's ready/take stamps (trace spans)
+        self._metric_keys: List = []
+        self._win_t_ready: Optional[float] = None
         self._thread: Optional[threading.Thread] = None
         if start:
             self._thread = threading.Thread(
@@ -178,11 +183,14 @@ class IngestFrontend:
             if batch_id is None:
                 batch_id = self._cursor(source).next_id()
             ticket = Ticket(batch_id)
+            if _trace.ENABLED:
+                ticket.trace = _trace.mint(batch_id, t0)
             if batch_id in self._admitted:
                 self.deduped += 1
                 ticket._resolve(TicketResult(
                     DEDUPED, batch_id,
                     reason="batch_id already admitted"))
+                self._trace_submit(ticket, "deduped")
                 return ticket
             device = hasattr(batch, "nonzero")
             rows = 0 if device else len(batch)
@@ -192,6 +200,7 @@ class IngestFrontend:
                 self._note_admitted(batch_id)
                 ticket._resolve(TicketResult(APPLIED, batch_id,
                                              reason="empty batch"))
+                self._trace_submit(ticket, "empty")
                 return ticket
             nbytes = batch_nbytes(batch)
             if not self._admit(source, nbytes, ticket, batch_id, deadline):
@@ -205,6 +214,7 @@ class IngestFrontend:
                     DEDUPED, batch_id,
                     reason="batch_id admitted concurrently while this "
                            "submit was blocked on backpressure"))
+                self._trace_submit(ticket, "deduped")
                 return ticket
             entry = Entry(ticket, source, batch, batch_id, nbytes,
                           time.perf_counter(), device, rows)
@@ -216,9 +226,22 @@ class IngestFrontend:
             self.inflight_bytes_peak = max(
                 self.inflight_bytes_peak,
                 self._queues.queued_bytes + self._queues.executing_bytes)
+            self._trace_submit(ticket, "admitted")
             self._work.notify()
             self._crash_point("producer_admitted")
         return ticket
+
+    @staticmethod
+    def _trace_submit(ticket: Ticket, outcome: str) -> None:
+        # producer-track span covering this submit() call: admission
+        # wait plus its terminal outcome (the sampled ticket's own
+        # six-stage timeline is emitted at resolve time by the pump)
+        ctx = ticket.trace
+        if ctx is not None and ctx.sampled:
+            _trace.evt("submit", ctx.t0,
+                       time.perf_counter() - ctx.t0,
+                       args={"batch_id": ticket.batch_id,
+                             "outcome": outcome})
 
     def _admit(self, source: Node, nbytes: int, ticket: Ticket,
                batch_id: str, deadline: Optional[float]) -> bool:
@@ -229,6 +252,7 @@ class IngestFrontend:
                 self.rejected += 1
                 ticket._resolve(TicketResult(
                     REJECTED, batch_id, reason="backpressure: queue full"))
+                self._trace_submit(ticket, "rejected")
                 return False
             if self.policy == "shed-oldest":
                 if not self._queues.fits_alone(nbytes):
@@ -237,6 +261,7 @@ class IngestFrontend:
                         REJECTED, batch_id,
                         reason=f"batch of {nbytes}B exceeds the "
                                f"{self._queues.max_bytes}B budget"))
+                    self._trace_submit(ticket, "rejected")
                     return False
                 shed_any = False
                 for e in self._queues.shed_for(source.id, nbytes):
@@ -249,6 +274,7 @@ class IngestFrontend:
                     e.ticket._resolve(TicketResult(
                         SHED, e.batch_id,
                         reason="shed-oldest backpressure; re-send"))
+                    self._trace_submit(e.ticket, "shed")
                 if shed_any:
                     # freed bytes are budget-wide: a sibling graph's
                     # blocked producer may fit now
@@ -264,12 +290,14 @@ class IngestFrontend:
                 ticket._resolve(TicketResult(
                     REJECTED, batch_id,
                     reason="backpressure: admission timed out"))
+                self._trace_submit(ticket, "rejected")
                 return False
             if not self._not_full.wait(timeout=remaining):
                 self.rejected += 1
                 ticket._resolve(TicketResult(
                     REJECTED, batch_id,
                     reason="backpressure: admission timed out"))
+                self._trace_submit(ticket, "rejected")
                 return False
             if self._state != "running":
                 raise FrontendClosed(
@@ -420,7 +448,25 @@ class IngestFrontend:
             if self._state == "closing" and not self._closing_flush:
                 self._exit_pump_locked()
 
+    def publish_metrics(self, registry=None) -> str:
+        """Register this frontend's live counters (the
+        ``summarize_serve().to_dict()`` schema) as an obs metric source
+        — live snapshots and offline summaries stay one schema.
+        Unregistered automatically at :meth:`close`. Returns the source
+        key (``serve.<name>``)."""
+        from reflow_tpu.obs import REGISTRY
+        from reflow_tpu.utils.metrics import summarize_serve
+        reg = registry if registry is not None else REGISTRY
+        key = f"serve.{self.name or 'frontend'}"
+        reg.register_source(key,
+                            lambda: summarize_serve(self).to_dict())
+        self._metric_keys.append((reg, key))
+        return key
+
     def _seal(self) -> None:
+        for reg, key in self._metric_keys:
+            reg.unregister_source(key)
+        self._metric_keys = []
         closefn = getattr(self.sched, "close", None)
         if closefn is not None:
             closefn()
@@ -467,10 +513,18 @@ class IngestFrontend:
                     and self._queues.queued_batches > 0), None
         return self._fire_or_timeout(now)
 
-    def _take_window(self) -> Dict[int, List[Entry]]:
+    def _take_window(self, ready_since: Optional[float] = None
+                     ) -> Dict[int, List[Entry]]:
         """Claim the backlog as one macro-tick work item and set the
         in-flight latch; the caller must follow with ``_run_window``
-        (lock released) and ``_finish_window`` (lock re-held)."""
+        (lock released) and ``_finish_window`` (lock re-held).
+        ``ready_since`` (tier pool): when the window first became
+        eligible — the gap to now is cross-graph scheduling delay on
+        the trace timeline."""
+        if _trace.ENABLED:
+            now = time.perf_counter()
+            self._win_t_ready = ready_since if ready_since is not None \
+                else now
         drained = self._queues.drain_all()
         self._flush_pending = False
         self._executing = True
@@ -521,27 +575,57 @@ class IngestFrontend:
 
     def _run_window(self, drained: Dict[int, List[Entry]]) -> None:
         self._window_entries = drained  # crash path fails their tickets
+        tr = _trace.ENABLED
+        if tr:
+            t_w0 = time.perf_counter()
+            t_ready = self._win_t_ready or t_w0
         feeds = build_feeds(drained, self.window.max_rows)
+        if tr:
+            _trace.evt("host_merge", t_w0, time.perf_counter() - t_w0,
+                       args={"graph": self.name or "frontend",
+                             "feeds": len(feeds)})
         self._crash_point("pump_coalesce")
         k = self.window.max_ticks
         for i in range(0, len(feeds), k):
             chunk = feeds[i:i + k]
             tick0 = self.sched._tick
             self._crash_point("pump_before_tick")
+            if tr:
+                _trace.wal_accum_reset()
+                t_exec0 = time.perf_counter()
             self.sched.tick_many([f.batches for f in chunk],
                                  feed_ids=[f.ids for f in chunk])
+            if tr:
+                t_exec1 = time.perf_counter()
+                wal_s = _trace.wal_accum_take()
+                _trace.evt("pump_execute", t_exec0, t_exec1 - t_exec0,
+                           args={"graph": self.name or "frontend",
+                                 "ticks": len(chunk),
+                                 "wal_s": round(wal_s, 6)})
             self._crash_point("pump_after_tick")
             applied = 0
             for j, f in enumerate(chunk):
                 for entries in f.entries.values():
                     for e in entries:
+                        ctx = e.ticket.trace
                         e.ticket._resolve(TicketResult(
                             APPLIED, e.batch_id, tick=tick0 + j + 1,
                             coalesced_with=len(entries) - 1))
                         applied += 1
+                        if tr and ctx is not None and ctx.sampled:
+                            _trace.ticket_stages(
+                                ctx, t_adm=e.t_admitted, t_ready=t_ready,
+                                t_exec0=t_exec0, t_exec1=t_exec1,
+                                wal_s=wal_s,
+                                t_res=time.perf_counter())
             with self._lock:
                 self.ticks += len(chunk)
                 self.applied += applied
+        if tr:
+            _trace.evt("window", t_w0, time.perf_counter() - t_w0,
+                       args={"graph": self.name or "frontend",
+                             "feeds": len(feeds)})
+            self._win_t_ready = None
         with self._lock:
             self.pump_iterations += 1
             self.ticks_per_pump.append(len(feeds))

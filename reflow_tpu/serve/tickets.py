@@ -61,10 +61,13 @@ class Ticket:
     """Thread-safe future for one submission. Producers ``result()`` or
     poll ``done()``; only the frontend resolves it."""
 
-    __slots__ = ("batch_id", "_event", "_result", "_error")
+    __slots__ = ("batch_id", "trace", "_event", "_result", "_error")
 
     def __init__(self, batch_id: str):
         self.batch_id = batch_id
+        #: obs.trace.TraceCtx when tracing is enabled at submit time;
+        #: the pump reads it to emit the ticket's stage timeline
+        self.trace = None
         self._event = threading.Event()
         self._result: Optional[TicketResult] = None
         self._error: Optional[BaseException] = None

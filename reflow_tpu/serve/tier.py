@@ -52,6 +52,7 @@ from collections import deque
 from typing import Deque, Dict, List, Optional
 
 from reflow_tpu.graph import GraphError
+from reflow_tpu.obs import trace as _trace
 
 from .budget import AdmissionBudget
 from .coalesce import CoalesceWindow
@@ -168,6 +169,7 @@ class ServeTier:
         self.windows = 0
         self.pool_crashes = 0
         self._busy_s = 0.0
+        self._metric_keys: List = []
         self._t0 = time.perf_counter()
         self.pump_threads = pump_threads
         self._threads = [
@@ -266,6 +268,10 @@ class ServeTier:
                 raise TimeoutError(
                     f"tier close() timed out after {timeout}s waiting "
                     f"for {t.name}")
+        for reg, key in self._metric_keys:
+            reg.unregister_source(key)
+            reg.unregister_prefix(f"{key}.")
+        self._metric_keys = []
 
     def __enter__(self) -> "ServeTier":
         return self
@@ -274,6 +280,25 @@ class ServeTier:
         self.close(flush=exc == (None, None, None))
 
     # -- metrics -----------------------------------------------------------
+
+    def publish_metrics(self, registry=None, *, name: str = "tier"
+                        ) -> str:
+        """Register the tier's live summary (``summarize_tier``
+        schema, every graph nested) plus shared-budget occupancy gauges
+        as obs metric sources; unregistered at :meth:`close`. Returns
+        the source key."""
+        from reflow_tpu.obs import REGISTRY
+        from reflow_tpu.utils.metrics import summarize_tier
+        reg = registry if registry is not None else REGISTRY
+        reg.register_source(name,
+                            lambda: summarize_tier(self).to_dict())
+        reg.gauge(f"{name}.pump_utilization",
+                  lambda: self.pump_utilization)
+        reg.gauge(f"{name}.budget_used_bytes", lambda: self.budget.used)
+        reg.gauge(f"{name}.budget_occupancy",
+                  lambda: self.budget.used / self.budget.total_bytes)
+        self._metric_keys.append((reg, name))
+        return name
 
     @property
     def pump_utilization(self) -> float:
@@ -311,10 +336,15 @@ class ServeTier:
                                           else min(wait_t, w))
                     if ready:
                         picked = dwrr_pick(ready, self.quantum_rows)
-                        picked.sched_delay_s.append(
-                            now - picked._ready_since)
+                        ready_since = picked._ready_since
+                        picked.sched_delay_s.append(now - ready_since)
                         picked._ready_since = None
-                        drained = picked.frontend._take_window()
+                        if _trace.ENABLED:
+                            _trace.evt("pool_pick", ready_since,
+                                       now - ready_since,
+                                       args={"graph": picked.name})
+                        drained = picked.frontend._take_window(
+                            ready_since=ready_since)
                     else:
                         self._work.wait(timeout=wait_t)
             # -- macro-tick, unlocked (single-owner: the latch set by

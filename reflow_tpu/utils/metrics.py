@@ -11,13 +11,26 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import warnings
 from typing import List, Sequence
 
 import numpy as np
 
 __all__ = ["MetricsSummary", "ServeMetrics", "TierMetrics", "WalMetrics",
-           "summarize", "summarize_serve", "summarize_tier",
+           "percentile", "summarize", "summarize_serve", "summarize_tier",
            "summarize_wal", "profile_trace"]
+
+
+def percentile(xs, q: float) -> float:
+    """Shared percentile over any sample sequence (list, tuple, deque,
+    ndarray): the one helper every ``summarize_*`` and the obs tooling
+    use. Empty input answers 0.0 (a run that never exercised the path
+    reports a zero latency, not a crash); a single sample answers
+    itself at every q."""
+    xs = np.asarray(xs, dtype=float)
+    if xs.size == 0:
+        return 0.0
+    return float(np.percentile(xs, q))
 
 
 def _jsonify(obj):
@@ -141,9 +154,7 @@ class WalMetrics:
 def summarize_wal(wal, recovery=None) -> WalMetrics:
     """Aggregate a ``wal.WriteAheadLog``'s counters (and optionally a
     ``wal.RecoveryReport``'s replay counters) into one record."""
-    def pct(xs: List[float], q: float) -> float:
-        return float(np.percentile(xs, q)) if xs else 0.0
-
+    pct = percentile
     return WalMetrics(
         fsync_policy=wal.fsync_policy,
         appends=wal.appends,
@@ -199,9 +210,7 @@ class ServeMetrics:
 
 def summarize_serve(frontend) -> ServeMetrics:
     """Aggregate an ``IngestFrontend``'s counters into one record."""
-    def pct(xs, q: float) -> float:
-        return float(np.percentile(xs, q)) if len(xs) else 0.0
-
+    pct = percentile
     tp = frontend.ticks_per_pump
     return ServeMetrics(
         policy=frontend.policy,
@@ -262,9 +271,7 @@ class TierMetrics:
 def summarize_tier(tier) -> TierMetrics:
     """Aggregate a ``serve.ServeTier``'s pool/budget counters and every
     live graph's frontend counters into one record."""
-    def pct(xs, q: float) -> float:
-        return float(np.percentile(xs, q)) if len(xs) else 0.0
-
+    pct = percentile
     handles = tier.graphs()
     shares = tier.budget.shares()
     per_graph = {}
@@ -313,11 +320,24 @@ def profile_trace(log_dir: str):
             sched.tick()
 
     View with TensorBoard / xprof against the produced log dir.
-    """
-    import jax
 
-    jax.profiler.start_trace(log_dir)
+    Degrades gracefully: when ``jax.profiler`` is unavailable (CPU-only
+    builds, stripped wheels) or refuses to start, the context runs the
+    block untraced and warns instead of raising — profiling is
+    observability, never correctness.
+    """
+    try:
+        import jax
+
+        start, stop = jax.profiler.start_trace, jax.profiler.stop_trace
+        start(log_dir)
+    except Exception as e:  # noqa: BLE001 - degrade to a no-op trace
+        warnings.warn(
+            f"jax.profiler unavailable ({e!r}); profile_trace is a "
+            f"no-op for this block", RuntimeWarning, stacklevel=3)
+        yield
+        return
     try:
         yield
     finally:
-        jax.profiler.stop_trace()
+        stop()

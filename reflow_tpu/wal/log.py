@@ -48,6 +48,8 @@ from collections import deque
 from typing import (Deque, Dict, Iterable, List, NamedTuple, Optional,
                     Tuple)
 
+from reflow_tpu.obs import trace as _trace
+
 __all__ = ["LogPosition", "TornTail", "WalError", "WriteAheadLog",
            "list_segments", "scan_wal"]
 
@@ -178,6 +180,11 @@ class WriteAheadLog:
         self._unsynced_appends += 1
         self.bytes_written += len(frame)
         self.append_s.append(time.perf_counter() - t0)
+        if _trace.ENABLED:
+            dur = time.perf_counter() - t0
+            _trace.evt("wal_append", t0, dur, track="wal",
+                       args={"bytes": len(frame)})
+            _trace.wal_accum_add(dur)
         end = (self._seq, self._offset)
         if self._offset >= self.segment_bytes:
             self.rotate()
@@ -221,6 +228,11 @@ class WriteAheadLog:
         os.fsync(self._f.fileno())
         self.fsyncs += 1
         self.fsync_s.append(time.perf_counter() - t0)
+        if _trace.ENABLED:
+            dur = time.perf_counter() - t0
+            _trace.evt("wal_fsync", t0, dur, track="wal",
+                       args={"covered": self._unsynced_appends})
+            _trace.wal_accum_add(dur)
         if self._unsynced_appends:
             self.group_sizes.append(self._unsynced_appends)
             self._unsynced_appends = 0
@@ -267,6 +279,20 @@ class WriteAheadLog:
                 os.remove(path)
                 removed.append(path)
         return removed
+
+    def publish_metrics(self, registry=None, *, name: str = "wal"
+                        ) -> str:
+        """Register this log's live summary (the ``summarize_wal``
+        schema: append/fsync latency percentiles, group-commit shape)
+        as an obs metric source. Returns the source key."""
+        from reflow_tpu.obs import REGISTRY
+        from reflow_tpu.utils.metrics import summarize_wal
+        reg = registry if registry is not None else REGISTRY
+        reg.register_source(name,
+                            lambda: summarize_wal(self).to_dict())
+        reg.gauge(f"{name}.fsync_rate",
+                  lambda: self.fsyncs / max(self.appends, 1))
+        return name
 
     def close(self) -> None:
         with self._lock:

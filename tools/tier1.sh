@@ -67,4 +67,28 @@ print(f"TIER1 tier smoke: {r['tier_rows_per_s_4g_2threads']} rows/s "
       f"{r['quiet_admission_p99_us']}us")
 EOF
 fi
+
+# optional (RUN_BENCH=1): the obs-mode smoke — tracing + telemetry on
+# the 16-producer serve protocol: the exported chrome trace must be
+# valid JSON with span events, and every sampled ticket's stage
+# durations must sum to within 10% of its end-to-end latency.
+if [ "${RUN_BENCH:-0}" = "1" ] && [ $rc -eq 0 ]; then
+  REFLOW_BENCH_OBS=1 REFLOW_BENCH_SMOKE=1 JAX_PLATFORMS=cpu \
+    REFLOW_TRACE_OUT=/tmp/_t1_obs_trace.json \
+    timeout -k 10 300 python bench.py > /tmp/_t1_obs.json || rc=3
+  python - <<'EOF' || rc=3
+import json
+r = json.load(open("/tmp/_t1_obs.json"))
+assert r["decomposition_ok"], r
+assert r["snapshot_schema_ok"], r
+t = json.load(open(r["trace_file"]))  # must parse as chrome trace JSON
+evs = [e for e in t["traceEvents"] if e.get("ph") == "X"]
+assert evs and all("ts" in e and "dur" in e and "tid" in e for e in evs), \
+    "trace events malformed"
+print(f"TIER1 obs smoke: {r['sampled_tickets']} tickets decomposed "
+      f"(max dev {100 * r['decomposition_max_dev_frac']:.2f}%), "
+      f"{len(evs)} trace spans, overhead "
+      f"{100 * r['obs_overhead_frac']:.2f}%")
+EOF
+fi
 exit $rc

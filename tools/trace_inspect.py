@@ -1,0 +1,138 @@
+#!/usr/bin/env python3
+"""Per-stage latency breakdown + critical path of a reflow trace.
+
+Usage::
+
+    python tools/trace_inspect.py trace.json           # human report
+    python tools/trace_inspect.py trace.json --json    # machine summary
+
+Input is the Chrome trace-event JSON written by
+``reflow_tpu.obs.export_chrome_trace()`` (either the
+``{"traceEvents": [...]}`` object or a bare event array). The report
+has two halves:
+
+- **spans**: p50/p99/total for every named span across all tracks
+  (windows, ticks, WAL appends/fsyncs, device dispatches);
+- **tickets**: the sampled tickets' end-to-end latency decomposed into
+  the six pipeline stages (admission → coalesce → sched_delay →
+  execute → fsync → resolve), with the **critical path** — stages
+  ranked by their mean share of end-to-end latency — and the worst
+  decomposition deviation (stage sums are tiled, so this should sit at
+  ~0%; large values mean a clock or export bug).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from reflow_tpu.obs.export import ticket_timelines  # noqa: E402
+from reflow_tpu.obs.trace import STAGES  # noqa: E402
+from reflow_tpu.utils.metrics import percentile  # noqa: E402
+
+
+def load_events(path: str) -> list:
+    with open(path) as f:
+        raw = json.load(f)
+    return raw["traceEvents"] if isinstance(raw, dict) else raw
+
+
+def inspect(path: str) -> dict:
+    """Summarize one trace file; the dict is the ``--json`` output."""
+    events = load_events(path)
+    by_name: dict = defaultdict(list)
+    tracks = set()
+    for ev in events:
+        if ev.get("ph") == "X":
+            by_name[ev.get("name", "?")].append(float(ev.get("dur", 0.0)))
+            tracks.add(ev.get("tid"))
+    spans = {
+        name: {"count": len(durs),
+               "p50_us": round(percentile(durs, 50), 3),
+               "p99_us": round(percentile(durs, 99), 3),
+               "total_ms": round(sum(durs) / 1e3, 3)}
+        for name, durs in sorted(by_name.items())}
+
+    tickets = ticket_timelines(events)
+    e2e = [t["e2e_us"] for t in tickets.values()]
+    stage_durs = {s: [t["stages"].get(s, 0.0) for t in tickets.values()]
+                  for s in STAGES}
+    mean_e2e = sum(e2e) / len(e2e) if e2e else 0.0
+    stage_summary = {}
+    for s in STAGES:
+        durs = stage_durs[s]
+        mean = sum(durs) / len(durs) if durs else 0.0
+        stage_summary[s] = {
+            "p50_us": round(percentile(durs, 50), 3),
+            "p99_us": round(percentile(durs, 99), 3),
+            "mean_share": round(mean / mean_e2e, 4) if mean_e2e else 0.0,
+        }
+    critical_path = sorted(
+        STAGES, key=lambda s: stage_summary[s]["mean_share"],
+        reverse=True)
+    max_dev = 0.0
+    for t in tickets.values():
+        if t["e2e_us"] > 0:
+            max_dev = max(max_dev, abs(t["sum_us"] - t["e2e_us"])
+                          / t["e2e_us"])
+    return {
+        "schema": "reflow.trace_inspect/1",
+        "trace_file": path,
+        "events": sum(len(d) for d in by_name.values()),
+        "tracks": len(tracks),
+        "spans": spans,
+        "tickets": len(tickets),
+        "ticket_e2e_p50_us": round(percentile(e2e, 50), 3),
+        "ticket_e2e_p99_us": round(percentile(e2e, 99), 3),
+        "ticket_stages": stage_summary,
+        "critical_path": critical_path,
+        "decomposition_max_dev_frac": round(max_dev, 6),
+    }
+
+
+def _print_human(s: dict) -> None:
+    print(f"{s['trace_file']}: {s['events']} span(s) on "
+          f"{s['tracks']} track(s)")
+    print(f"{'span':<16} {'count':>7} {'p50_us':>12} {'p99_us':>12} "
+          f"{'total_ms':>10}")
+    for name, d in s["spans"].items():
+        print(f"{name:<16} {d['count']:>7} {d['p50_us']:>12.1f} "
+              f"{d['p99_us']:>12.1f} {d['total_ms']:>10.2f}")
+    if not s["tickets"]:
+        print("no sampled tickets in this trace "
+              "(REFLOW_TRACE_SAMPLE too high, or no serve traffic)")
+        return
+    print(f"\n{s['tickets']} sampled ticket(s): end-to-end "
+          f"p50 {s['ticket_e2e_p50_us']:.1f}us "
+          f"p99 {s['ticket_e2e_p99_us']:.1f}us "
+          f"(stage-sum deviation max "
+          f"{100 * s['decomposition_max_dev_frac']:.2f}%)")
+    print(f"{'stage':<12} {'p50_us':>12} {'p99_us':>12} {'share':>8}")
+    for name in s["critical_path"]:
+        d = s["ticket_stages"][name]
+        print(f"{name:<12} {d['p50_us']:>12.1f} {d['p99_us']:>12.1f} "
+              f"{100 * d['mean_share']:>7.1f}%")
+    print(f"critical path: {' > '.join(s['critical_path'][:3])}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as one JSON line")
+    args = ap.parse_args(argv)
+    summary = inspect(args.trace)
+    if args.json:
+        print(json.dumps(summary))
+    else:
+        _print_human(summary)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -67,13 +67,25 @@ def inspect(wal_dir: str, *, verbose: bool = True) -> dict:
     coalesced_records = coalesced_ids = max_ids = 0
     push_runs: list = []
     run = 0
+    per_seg: dict = {
+        seg: {"segment": seg, "bytes": os.path.getsize(path),
+              "records": 0, "pushes": 0, "rows": 0, "micro_batches": 0}
+        for seg, path in segs}
     for pos, rec in records:
         kind = rec.get("kind", "?")
         counts[kind] = counts.get(kind, 0) + 1
+        seg = per_seg.get(pos.segment)
+        if seg is not None:
+            seg["records"] += 1
         if kind == "push":
-            rows += len(np.asarray(rec["weights"]))
+            n = len(np.asarray(rec["weights"]))
+            rows += n
             run += 1
             ids = rec.get("batch_ids")
+            if seg is not None:
+                seg["pushes"] += 1
+                seg["rows"] += n
+                seg["micro_batches"] += len(ids) if ids else 1
             if ids:
                 coalesced_records += 1
                 coalesced_ids += len(ids)
@@ -88,7 +100,10 @@ def inspect(wal_dir: str, *, verbose: bool = True) -> dict:
             print(f"  {pos.segment:08d}:{pos.offset:<10} {_describe(rec)}")
     if run:
         push_runs.append(run)
+    win = np.asarray(push_runs, dtype=float)
     return {
+        # same schema family as reflow_tpu.obs snapshots / trace_inspect
+        "schema": "reflow.wal_inspect/1",
         "wal_dir": wal_dir,
         "segments": len(segs),
         "bytes": sum(os.path.getsize(p) for _s, p in segs),
@@ -101,6 +116,12 @@ def inspect(wal_dir: str, *, verbose: bool = True) -> dict:
         "max_replay_unit_ids": max_ids,
         "commit_windows": len(push_runs),
         "commit_window_max_pushes": max(push_runs) if push_runs else 0,
+        "commit_window_pushes": push_runs,
+        "commit_window_p50_pushes": (
+            float(np.percentile(win, 50)) if len(win) else 0.0),
+        "commit_window_p95_pushes": (
+            float(np.percentile(win, 95)) if len(win) else 0.0),
+        "segments_detail": [per_seg[s] for s in sorted(per_seg)],
         "torn_tail": torn._asdict() if torn is not None else None,
     }
 
@@ -135,6 +156,11 @@ def main(argv=None) -> int:
                   f"{summary['commit_windows']} commit window(s), "
                   f"largest {summary['commit_window_max_pushes']} "
                   f"push(es)")
+        for seg in summary["segments_detail"]:
+            print(f"segment {seg['segment']:08d}: {seg['bytes']:>8} bytes "
+                  f"{seg['records']:>5} record(s) {seg['pushes']:>5} "
+                  f"push(es) {seg['rows']:>7} row(s) "
+                  f"{seg['micro_batches']:>5} micro-batch(es)")
         if torn:
             print(f"torn tail (tolerated): segment {torn['segment']} @ "
                   f"{torn['offset']}: {torn['reason']}")
