@@ -30,6 +30,9 @@ class Executor(abc.ABC):
     def __init__(self):
         self.graph: FlowGraph | None = None
         self.states: Dict[int, object] = {}
+        #: device→host readbacks done by :meth:`materialize` (forced
+        #: syncs on a streaming path; always 0 for host executors)
+        self.materialize_count = 0
 
     def bind(self, graph: FlowGraph) -> None:
         """Attach to a validated graph and allocate per-node state."""

@@ -474,6 +474,7 @@ class TpuExecutor(Executor):
 
     def materialize(self, batch) -> DeltaBatch:
         if isinstance(batch, DeviceDelta):
+            self.materialize_count += 1
             return to_host(batch)
         return batch
 

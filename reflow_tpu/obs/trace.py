@@ -22,14 +22,16 @@ the aggregate per-thread spans (windows, ticks, WAL appends).
 
 The stage tiling is exact by construction: ``admission`` ``[t0,t_adm]``,
 ``coalesce`` ``[t_adm,t_ready]``, ``sched_delay`` ``[t_ready,t_exec0]``,
-``execute`` ``[t_exec0,t_exec1-wal_s]``, ``fsync`` ``[t_exec1-wal_s,
-t_exec1]``, ``resolve`` ``[t_exec1,t_res]`` — the six durations tile
-``[t0,t_res]`` with no gaps or overlap, so they sum to the measured
-end-to-end ticket latency (the 10% acceptance budget is headroom for
-export rounding, not for model error). ``wal_s`` is gathered by a
-thread-local accumulator the WAL feeds during ``append_group``/fsync on
-the pump thread, letting the frontend subtract durable-log time out of
-the execute span it straddles.
+``execute`` ``[t_exec0,t_exec1]``, ``fsync`` ``[t_exec1,t_dur]``,
+``resolve`` ``[t_dur,t_res]`` — the six durations tile ``[t0,t_res]``
+with no gaps or overlap, so they sum to the measured end-to-end ticket
+latency (the 10% acceptance budget is headroom for export rounding, not
+for model error). With the asynchronous WAL committer the ``fsync``
+stage is the *durability wait*: the gap between the execute finishing
+(``t_exec1``) and the ticket's LSN passing the durable watermark
+(``t_dur``) — near-zero when the committer's fsync fully overlapped the
+execute, the exposed disk latency when it didn't. The committer's own
+``wal_fsync`` spans land on the ``wal-committer`` track.
 """
 
 from __future__ import annotations
@@ -155,33 +157,38 @@ def mint(batch_id: str, t0: float) -> TraceCtx:
 
 
 def ticket_stages(ctx: TraceCtx, *, t_adm: float, t_ready: float,
-                  t_exec0: float, t_exec1: float, wal_s: float,
+                  t_exec0: float, t_exec1: float, t_dur: float,
                   t_res: float) -> None:
     """Emit the six-stage end-to-end timeline of one sampled ticket onto
-    its own ``ticket/<batch_id>`` track. Boundaries are clamped into
-    pipeline order so the stages tile ``[ctx.t0, t_res]`` exactly."""
+    its own ``ticket/<batch_id>`` track. ``t_dur`` is the durability
+    point — when the ticket's LSN passed ``wal.wait_durable`` (equal to
+    ``t_exec1`` on a non-durable scheduler, so the fsync stage collapses
+    to zero). Boundaries are clamped into pipeline order so the stages
+    tile ``[ctx.t0, t_res]`` exactly."""
     if not ENABLED:
         return
     track = f"ticket/{ctx.batch_id}"
     t_adm = max(ctx.t0, min(t_adm, t_exec0))
     c1 = max(t_adm, min(t_ready, t_exec0))      # coalesce end
-    w = max(0.0, min(wal_s, t_exec1 - t_exec0))  # fsync share of execute
-    e1 = t_exec1 - w                            # execute end
+    t_res = max(t_exec1, t_res)
+    d = max(t_exec1, min(t_dur, t_res))         # durability point
     spans = (("admission", ctx.t0, t_adm),
              ("coalesce", t_adm, c1),
              ("sched_delay", c1, t_exec0),
-             ("execute", t_exec0, e1),
-             ("fsync", e1, t_exec1),
-             ("resolve", t_exec1, max(t_exec1, t_res)))
+             ("execute", t_exec0, t_exec1),
+             ("fsync", t_exec1, d),
+             ("resolve", d, t_res))
     ring = _ring()
     for name, s, e in spans:
         ring.put((name, s, e - s, track, {"batch_id": ctx.batch_id}))
 
 
-# -- WAL time accumulator ----------------------------------------------------
-# append_group/fsync run on the pump thread *inside* the frontend's
-# execute window; the WAL adds its wall time here so the frontend can
-# carve a distinct fsync stage out of the execute span.
+# -- WAL time accumulator (legacy) -------------------------------------------
+# Pre-pipeline tiling carved WAL append+fsync wall time out of the
+# execute span via this thread-local; with the asynchronous committer
+# the fsync stage is measured directly as the durability wait
+# ([t_exec1, t_dur]), so the frontend no longer feeds it. Kept for
+# external instrumentation that still accumulates per-thread WAL time.
 
 def wal_accum_reset() -> None:
     _tls.wal_s = 0.0

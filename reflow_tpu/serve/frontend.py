@@ -39,6 +39,17 @@ Steady-state traffic rides the fused streaming path: the pump calls
 no mid-stream forced syncs happen — the zero-``forced_syncs`` property
 ``REFLOW_BENCH_SERVE=1`` asserts.
 
+Durability pipeline (durable schedulers): the pump never blocks on an
+fsync. ``tick_many(wait_durable=False)`` returns once the window's WAL
+records are written+flushed; ticket resolution is deferred into a
+:class:`_ResBlock` registered with ``wal.when_durable(lsn, ...)`` and
+fires when the committer thread's fsync passes the window's LSN — so
+window N's disk latency overlaps window N+1's merge and dispatch, while
+commit-before-resolve holds (a crash between execute and fsync leaves
+the tickets unresolved; upstream re-sends, replay dedups). Device
+batches submitted with ``preimage=`` log the host pre-image instead of
+paying a device readback (``DurableScheduler.push_preimage``).
+
 Crash seams (``utils.faults.CrashInjector``): ``producer_submit`` /
 ``producer_admitted`` on the submitting thread, ``pump_coalesce`` /
 ``pump_before_tick`` / ``pump_after_tick`` on the pump. A named
@@ -51,10 +62,11 @@ producers; a durable scheduler's WAL then carries exactly-once across
 
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from collections import deque
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Tuple
 
 from reflow_tpu.graph import GraphError, Node
 from reflow_tpu.obs import trace as _trace
@@ -69,6 +81,23 @@ from .tickets import (APPLIED, DEDUPED, REJECTED, SHED, FrontendClosed,
 __all__ = ["IngestFrontend"]
 
 POLICIES = ("block", "reject", "shed-oldest")
+
+
+@dataclasses.dataclass
+class _ResBlock:
+    """One executed chunk awaiting its durability point: the tickets of
+    a ``tick_many`` call whose WAL records are written but possibly not
+    yet fsynced. Resolution fires from ``wal.when_durable`` — on the
+    committer thread when the fsync overlapped later work, inline on
+    the pump when the LSN was already durable."""
+
+    #: (entry, committed tick, coalesced_with) per micro-batch
+    items: List[Tuple[Entry, int, int]]
+    lsn: int
+    nticks: int
+    t_ready: float
+    t_exec0: float
+    t_exec1: float
 
 #: per-sample metric retention: percentile summaries only need a recent
 #: window, and a long-running serving process must not grow them forever
@@ -127,6 +156,8 @@ class IngestFrontend:
         self._paused = False
         self._executing = False
         self._flush_pending = False
+        #: executed chunks whose tickets await the durable watermark
+        self._pending_res = 0
         self.pump_error: Optional[BaseException] = None
         # -- counters/samples (utils.metrics.summarize_serve) --
         self.submitted = 0
@@ -164,14 +195,23 @@ class IngestFrontend:
     # -- producer side -----------------------------------------------------
 
     def submit(self, source: Node, batch, *, batch_id: Optional[str] = None,
-               timeout: Optional[float] = None) -> Ticket:
+               timeout: Optional[float] = None, preimage=None) -> Ticket:
         """Admit one micro-batch for ``source``; returns a Ticket that
         resolves once the batch's fate is decided. Thread-safe; callable
         from any number of producers. ``timeout`` bounds a ``block``
-        admission wait (expiry resolves the ticket REJECTED)."""
+        admission wait (expiry resolves the ticket REJECTED).
+
+        ``preimage``: for a device-resident ``batch``, the host-side
+        ``DeltaBatch`` it was uploaded from — a durable scheduler then
+        logs these bytes instead of reading the device copy back (the
+        zero-readback logging path). Ignored for host batches."""
         if source.kind not in ("source", "loop"):
             raise GraphError(
                 f"can only submit to sources/loops, not {source}")
+        if preimage is not None and hasattr(preimage, "nonzero"):
+            raise GraphError(
+                "preimage must be the HOST DeltaBatch the device batch "
+                "was uploaded from, not another device batch")
         t0 = time.perf_counter()
         deadline = None if timeout is None else t0 + timeout
         with self._lock:
@@ -217,7 +257,8 @@ class IngestFrontend:
                 self._trace_submit(ticket, "deduped")
                 return ticket
             entry = Entry(ticket, source, batch, batch_id, nbytes,
-                          time.perf_counter(), device, rows)
+                          time.perf_counter(), device, rows,
+                          preimage=preimage if device else None)
             self._note_admitted(batch_id)
             self._queues.push(entry)
             self.admitted += 1
@@ -331,7 +372,8 @@ class IngestFrontend:
             self._flush_pending = True
             self._work.notify_all()
             try:
-                while self._queues.queued_batches or self._executing:
+                while (self._queues.queued_batches or self._executing
+                       or self._pending_res):
                     if self._state == "failed":
                         raise PumpCrashed(
                             f"pump died: {self.pump_error!r}")
@@ -394,17 +436,24 @@ class IngestFrontend:
         deadline = (None if timeout is None
                     else time.perf_counter() + timeout)
         with self._lock:
-            if self._state in ("closed", "failed"):
-                self._seal()
-                return
-            if self._state == "running":
-                self._closing_flush = flush
-            # else: a retry after a close() timeout — keep the original
-            # call's flush intent rather than silently downgrading it
-            self._state = "closing"
-            self._paused = False
-            self._not_full.notify_all()
-            self._work.notify_all()
+            seal_only = self._state in ("closed", "failed")
+        if seal_only:
+            # outside the lock: sealing a durable scheduler closes its
+            # WAL, whose final fsync may fire when_durable callbacks
+            # that re-take this (non-reentrant) lock
+            self._seal()
+            return
+        with self._lock:
+            if self._state not in ("closed", "failed"):
+                if self._state == "running":
+                    self._closing_flush = flush
+                # else: a retry after a close() timeout — keep the
+                # original call's flush intent rather than silently
+                # downgrading it
+                self._state = "closing"
+                self._paused = False
+                self._not_full.notify_all()
+                self._work.notify_all()
         if self._thread is not None:
             if self._thread.is_alive():
                 self._thread.join(timeout=timeout)
@@ -521,10 +570,8 @@ class IngestFrontend:
         ``ready_since`` (tier pool): when the window first became
         eligible — the gap to now is cross-graph scheduling delay on
         the trace timeline."""
-        if _trace.ENABLED:
-            now = time.perf_counter()
-            self._win_t_ready = ready_since if ready_since is not None \
-                else now
+        self._win_t_ready = (ready_since if ready_since is not None
+                             else time.perf_counter())
         drained = self._queues.drain_all()
         self._flush_pending = False
         self._executing = True
@@ -576,60 +623,128 @@ class IngestFrontend:
     def _run_window(self, drained: Dict[int, List[Entry]]) -> None:
         self._window_entries = drained  # crash path fails their tickets
         tr = _trace.ENABLED
-        if tr:
-            t_w0 = time.perf_counter()
-            t_ready = self._win_t_ready or t_w0
+        t_w0 = time.perf_counter()
+        t_ready = self._win_t_ready or t_w0
         feeds = build_feeds(drained, self.window.max_rows)
         if tr:
             _trace.evt("host_merge", t_w0, time.perf_counter() - t_w0,
                        args={"graph": self.name or "frontend",
                              "feeds": len(feeds)})
         self._crash_point("pump_coalesce")
+        wal = getattr(self.sched, "wal", None)
+        push_pre = getattr(self.sched, "push_preimage", None)
+        if wal is not None and push_pre is not None:
+            # ingest-time pre-images: hand the durable scheduler the
+            # host payloads captured at submit() so device batches log
+            # without a forced readback
+            for f in feeds:
+                for entries in f.entries.values():
+                    for e in entries:
+                        if e.device and e.preimage is not None:
+                            push_pre(e.batch_id, e.preimage)
         k = self.window.max_ticks
         for i in range(0, len(feeds), k):
             chunk = feeds[i:i + k]
             tick0 = self.sched._tick
             self._crash_point("pump_before_tick")
+            t_exec0 = time.perf_counter()
+            if wal is not None:
+                self.sched.tick_many([f.batches for f in chunk],
+                                     feed_ids=[f.ids for f in chunk],
+                                     wait_durable=False)
+                lsn = wal.last_lsn()
+            else:
+                self.sched.tick_many([f.batches for f in chunk],
+                                     feed_ids=[f.ids for f in chunk])
+                lsn = 0
+            t_exec1 = time.perf_counter()
             if tr:
-                _trace.wal_accum_reset()
-                t_exec0 = time.perf_counter()
-            self.sched.tick_many([f.batches for f in chunk],
-                                 feed_ids=[f.ids for f in chunk])
-            if tr:
-                t_exec1 = time.perf_counter()
-                wal_s = _trace.wal_accum_take()
                 _trace.evt("pump_execute", t_exec0, t_exec1 - t_exec0,
                            args={"graph": self.name or "frontend",
-                                 "ticks": len(chunk),
-                                 "wal_s": round(wal_s, 6)})
+                                 "ticks": len(chunk), "lsn": lsn})
             self._crash_point("pump_after_tick")
-            applied = 0
+            items = []
             for j, f in enumerate(chunk):
                 for entries in f.entries.values():
                     for e in entries:
-                        ctx = e.ticket.trace
-                        e.ticket._resolve(TicketResult(
-                            APPLIED, e.batch_id, tick=tick0 + j + 1,
-                            coalesced_with=len(entries) - 1))
-                        applied += 1
-                        if tr and ctx is not None and ctx.sampled:
-                            _trace.ticket_stages(
-                                ctx, t_adm=e.t_admitted, t_ready=t_ready,
-                                t_exec0=t_exec0, t_exec1=t_exec1,
-                                wal_s=wal_s,
-                                t_res=time.perf_counter())
+                        items.append((e, tick0 + j + 1, len(entries) - 1))
+            block = _ResBlock(items, lsn, len(chunk), t_ready,
+                              t_exec0, t_exec1)
             with self._lock:
-                self.ticks += len(chunk)
-                self.applied += applied
+                self._pending_res += 1
+            if wal is None:
+                self._complete_block(block, None)
+                continue
+            # pipelined resolution: commit-before-resolve holds, but
+            # the commit (the fsync) may still be in flight — park the
+            # tickets on the durable watermark so this loop (and the
+            # next window) overlaps the disk latency instead of
+            # serializing behind it
+            try:
+                deferred = wal.when_durable(
+                    lsn, lambda err, b=block: self._complete_block(b, err))
+            except BaseException:
+                with self._lock:
+                    self._pending_res -= 1
+                raise
+            if not deferred:
+                self._complete_block(block, None)
         if tr:
             _trace.evt("window", t_w0, time.perf_counter() - t_w0,
                        args={"graph": self.name or "frontend",
                              "feeds": len(feeds)})
-            self._win_t_ready = None
+        self._win_t_ready = None
         with self._lock:
             self.pump_iterations += 1
             self.ticks_per_pump.append(len(feeds))
         self._window_entries = None
+
+    def _complete_block(self, block: _ResBlock,
+                        err: Optional[BaseException]) -> None:
+        """Resolve one executed chunk's tickets at its durability point.
+        Runs inline on the pump (LSN already durable / non-durable
+        scheduler) or on the WAL committer thread via ``when_durable``
+        (pipelined fsync). ``err`` is the committer's death cause — the
+        chunk's records may never become durable, so its undecided
+        tickets fail with :class:`PumpCrashed` instead (the upstream
+        re-sends; replay after ``recover()`` dedups)."""
+        if err is not None:
+            crash = PumpCrashed(
+                f"wal committer died before the window's records were "
+                f"durable: {err!r}")
+            crash.__cause__ = err
+            with self._lock:
+                self._state = "failed"
+                self.pump_error = err
+                self._pending_res -= 1
+                self._not_full.notify_all()
+                self._work.notify_all()
+                self._idle.notify_all()
+            for e, _tick, _co in block.items:
+                if not e.ticket.done():
+                    e.ticket._fail(crash)
+            return
+        tr = _trace.ENABLED
+        t_dur = time.perf_counter()
+        applied = 0
+        for e, tick, co in block.items:
+            if e.ticket.done():
+                continue  # a pump-crash path decided it first
+            ctx = e.ticket.trace
+            e.ticket._resolve(TicketResult(
+                APPLIED, e.batch_id, tick=tick, coalesced_with=co,
+                lsn=block.lsn or None))
+            applied += 1
+            if tr and ctx is not None and ctx.sampled:
+                _trace.ticket_stages(
+                    ctx, t_adm=e.t_admitted, t_ready=block.t_ready,
+                    t_exec0=block.t_exec0, t_exec1=block.t_exec1,
+                    t_dur=t_dur, t_res=time.perf_counter())
+        with self._lock:
+            self._pending_res -= 1
+            self.ticks += block.nticks
+            self.applied += applied
+            self._idle.notify_all()
 
     def _on_pump_crash(self, error: BaseException,
                        window: Optional[Dict[int, List[Entry]]] = None,

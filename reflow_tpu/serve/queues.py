@@ -53,6 +53,10 @@ class Entry:
     device: bool
     #: host row count (0 for device batches — len() would read back)
     rows: int
+    #: host-side pre-image of a device batch, captured at submit()
+    #: BEFORE upload so a durable scheduler can log it without a forced
+    #: readback (None for host batches or when the producer has none)
+    preimage: object = None
 
 
 class SourceQueues:

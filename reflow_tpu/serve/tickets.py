@@ -51,6 +51,9 @@ class TicketResult:
     #: entry (APPLIED only; >0 means the merge path engaged)
     coalesced_with: int = 0
     reason: Optional[str] = None
+    #: WAL LSN the batch's window committed under (APPLIED on a durable
+    #: scheduler only — resolution gated on ``wal.wait_durable(lsn)``)
+    lsn: Optional[int] = None
 
     @property
     def applied(self) -> bool:

@@ -14,6 +14,11 @@ scheduler accepts a push, so every accepted batch is durable by the time
 - crash **mid-tick** (no ``tick`` marker yet): recovery replays the
   pushes and re-runs the tick deterministically from the checkpoint
   state.
+- crash **between write and fsync** (the asynchronous committer): the
+  execute may have finished, but acknowledgement gates on
+  ``wal.wait_durable`` — so the caller's ticket is still unresolved,
+  the upstream re-sends, and replay (of whatever prefix survived)
+  dedups. Folded once.
 
 Exactly-once across process death therefore needs nothing from the
 caller beyond what lossy-transport exactly-once already needed: stable
@@ -22,10 +27,25 @@ without an id get an auto-minted ``__wal__<source>@<n>`` id so replay
 still dedups — but the *caller's* re-send of such a batch cannot be
 recognized, so end-to-end exactly-once requires caller-supplied ids.
 
+Device-resident batches and pre-images (ROADMAP: "log device-resident
+batches without a forced sync"): durability needs the host bytes, but a
+readback of a device batch is a forced sync — on a tunnel runtime the
+degrading first-sync. The fix is **ingest-time pre-image logging**:
+whoever uploaded the batch had the host payload first; hand it to
+:meth:`DurableScheduler.push_preimage` (the serve frontend does this
+automatically from ``submit(..., preimage=...)``) and the WAL logs that
+pre-image while the device batch flows on untouched.
+``log_readbacks`` counts the fallback materializations — zero on a
+well-formed streaming path (the ``REFLOW_BENCH_WALPIPE=1`` assertion).
+
 Crash-point injection (``crash=utils.faults.CrashInjector(...)``) fires
-at the named seams above; ``utils.faults.tear_wal_tail`` tears the final
-record after the fact. Together they drive the crash-recovery
-differential tests.
+at the named seams above plus the WAL's own pipeline seams:
+``wal_enqueue`` on the appending thread (the frame is queued, nothing
+is on disk yet), then ``wal_before_write`` / ``wal_after_write`` and
+``wal_before_fsync`` / ``wal_after_fsync`` on the committer thread
+(inline committers fire the write/fsync seams on the appender itself);
+``utils.faults.tear_wal_tail`` tears the final record after the fact.
+Together they drive the crash-recovery differential tests.
 """
 
 from __future__ import annotations
@@ -44,21 +64,31 @@ class DurableScheduler(DirtyScheduler):
     """DirtyScheduler + write-ahead logging of accepted source batches.
 
     ``fsync`` picks the durability/latency point (log.py's contract):
-    ``"record"`` / ``"tick"`` (default) / ``"os"``. Device-resident
-    batches are materialized to host before logging — durability needs
-    the bytes, and that readback is a forced sync on a tunnel runtime;
-    keep WAL ingestion on host-side batches for streaming workloads.
+    ``"record"`` / ``"tick"`` (default) / ``"os"``. ``committer`` picks
+    where the fsync runs: ``"thread"`` (default — pipelined, off the
+    dispatch path) or ``"inline"`` (synchronous, the pre-pipeline
+    behavior). Device-resident batches log their host **pre-image**
+    when one was registered (:meth:`push_preimage`); without one they
+    are materialized to host — a forced readback the streaming path
+    must avoid (``log_readbacks`` counts them).
     """
 
     def __init__(self, graph, executor=None, *, wal_dir: str,
                  fsync: str = "tick", segment_bytes: int = 16 << 20,
-                 crash=None, **kwargs):
+                 committer: str = "thread", crash=None, **kwargs):
         super().__init__(graph, executor, **kwargs)
         self.wal = WriteAheadLog(wal_dir, fsync=fsync,
-                                 segment_bytes=segment_bytes)
+                                 segment_bytes=segment_bytes,
+                                 committer=committer, crash=crash)
         self._crash = crash
         self._wal_suspended = False  # recovery replay must not re-log
         self._auto_seq = 0
+        #: batch_id -> host pre-image of an uploaded device batch,
+        #: consumed (popped) when that batch is logged
+        self._preimages: Dict[str, DeltaBatch] = {}
+        #: forced host readbacks on the logging path (device batch, no
+        #: pre-image) — the streaming zero-readback property's counter
+        self.log_readbacks = 0
 
     # -- crash-point seam --------------------------------------------------
 
@@ -77,10 +107,38 @@ class DurableScheduler(DirtyScheduler):
             if bid not in self._seen_batch_ids:
                 return bid
 
+    def push_preimage(self, batch_id: str, batch: DeltaBatch) -> None:
+        """Register the host-side pre-image of a device batch about to
+        be pushed (or submitted) under ``batch_id``: the WAL logs these
+        bytes instead of reading the device copy back. The caller owns
+        the equivalence — the pre-image must be the exact batch that was
+        uploaded. Consumed by the next log of that id; unused pre-images
+        are dropped when their id resolves (dedup) or the log is
+        sealed."""
+        if hasattr(batch, "nonzero"):
+            raise ValueError(
+                f"pre-image for {batch_id!r} is itself device-resident; "
+                f"pass the host DeltaBatch that was uploaded")
+        self._preimages[batch_id] = batch
+
+    def _host_image(self, batch, batch_id: str):
+        """(host_bytes_for_log, batch_to_execute): a device batch with a
+        registered pre-image logs the pre-image and executes untouched;
+        without one it is materialized (counted) and the host copy both
+        logs and executes — the legacy forced-readback path."""
+        if not hasattr(batch, "nonzero"):
+            self._preimages.pop(batch_id, None)
+            return batch, batch
+        pre = self._preimages.pop(batch_id, None)
+        if pre is not None:
+            return pre, batch
+        self.log_readbacks += 1
+        host = self.executor.materialize(batch)
+        return host, host
+
     def _log_push(self, source: Node, batch: DeltaBatch,
                   batch_id: str) -> DeltaBatch:
-        if hasattr(batch, "nonzero"):  # device-resident: forced readback
-            batch = self.executor.materialize(batch)
+        image, batch = self._host_image(batch, batch_id)
         self._crash_point("before_append")
         self.wal.append({
             "kind": "push",
@@ -88,9 +146,9 @@ class DurableScheduler(DirtyScheduler):
             "node": source.id,
             "node_name": source.name,
             "batch_id": batch_id,
-            "keys": batch.keys,
-            "values": batch.values,
-            "weights": batch.weights,
+            "keys": image.keys,
+            "values": image.values,
+            "weights": image.weights,
         })
         self._crash_point("after_append")
         return batch
@@ -105,6 +163,7 @@ class DurableScheduler(DirtyScheduler):
         if batch_id is None:
             batch_id = self._mint_auto_id(source)
         elif batch_id in self._seen_batch_ids:
+            self._preimages.pop(batch_id, None)
             return False  # duplicate: nothing to make durable
         batch = self._log_push(source, batch, batch_id)
         accepted = super().push(source, batch, batch_id=batch_id)
@@ -126,7 +185,15 @@ class DurableScheduler(DirtyScheduler):
         return result
 
     def tick_many(self, feeds: Sequence[Dict[Node, DeltaBatch]], *,
-                  feed_ids=None) -> TickResult:
+                  feed_ids=None, wait_durable: bool = True) -> TickResult:
+        """``wait_durable=False`` is the pipelined-commit entry (the
+        serve frontend): the window's records and tick markers are
+        written + flushed and their durability REQUEST is enqueued, but
+        this call returns without blocking on the fsync. The caller must
+        gate every acknowledgement on ``wal.wait_durable(lsn)`` /
+        ``wal.when_durable(lsn, ...)`` with ``lsn = wal.last_lsn()``
+        read right after this returns — so window N's fsync overlaps
+        window N+1's host merge and dispatch."""
         if self._wal_suspended:
             return super().tick_many(feeds, feed_ids=feed_ids)
         # feeds bypass push(), so log them here first (append-before-
@@ -135,17 +202,16 @@ class DurableScheduler(DirtyScheduler):
         # without ids get an auto id so the replay is still idempotent.
         # The whole window is one wal.append_group — under
         # fsync="record" that is ONE fsync for the window (group
-        # commit), not one per micro-batch. Device-resident feeds get
-        # materialized — a forced sync that negates the macro-tick's
-        # pipelining; durable ingestion wants host-side feeds.
+        # commit), not one per micro-batch. Device-resident feeds log
+        # their registered pre-image (no readback); only an unregistered
+        # device feed pays the forced materialize.
         ids_seq = feed_ids if feed_ids is not None else [{}] * len(feeds)
         logged, records = [], []
         for feed, ids_map in zip(feeds, ids_seq):
             entry = {}
             for src, b in feed.items():
                 ids = list(ids_map.get(src, ())) or [self._mint_auto_id(src)]
-                if hasattr(b, "nonzero"):  # device-resident: forced readback
-                    b = self.executor.materialize(b)
+                image, b = self._host_image(b, ids[0])
                 entry[src] = b
                 rec = {
                     "kind": "push",
@@ -153,9 +219,9 @@ class DurableScheduler(DirtyScheduler):
                     "node": src.id,
                     "node_name": src.name,
                     "batch_id": ids[0],
-                    "keys": b.keys,
-                    "values": b.values,
-                    "weights": b.weights,
+                    "keys": image.keys,
+                    "values": image.values,
+                    "weights": image.weights,
                 }
                 if len(ids) > 1:
                     # several micro-batches coalesced into this one feed
@@ -164,7 +230,10 @@ class DurableScheduler(DirtyScheduler):
                 records.append(rec)
             logged.append(entry)
         self._crash_point("before_append")
-        self.wal.append_group(records)
+        # request=False: the window is ONE logical commit — the marker
+        # group below carries the single durability barrier covering
+        # data + markers (acknowledgement gates on the marker LSN)
+        self.wal.append_group(records, wait=False, request=False)
         self._crash_point("after_append")
         # suspend the per-tick overrides during execution: the fallback
         # path runs self.tick() per feed, and its per-tick markers would
@@ -177,8 +246,11 @@ class DurableScheduler(DirtyScheduler):
         tick_now = self._tick
         self.wal.append_group([
             {"kind": "tick", "tick": t}
-            for t in range(tick_now - len(feeds) + 1, tick_now + 1)])
-        self.wal.note_tick()
+            for t in range(tick_now - len(feeds) + 1, tick_now + 1)],
+            wait=False)
+        self.wal.note_tick(wait=False)
+        if wait_durable:
+            self.wal.wait_durable(self.wal.last_lsn())
         self._crash_point("after_tick")
         return result
 
@@ -186,4 +258,5 @@ class DurableScheduler(DirtyScheduler):
         """Durably flush and seal the log (clean shutdown). Idempotent —
         the serving frontend's ``close()`` and a caller's own shutdown
         path may both reach it."""
+        self._preimages.clear()
         self.wal.close()

@@ -18,14 +18,47 @@ the log:
 
 Durability contract by fsync policy (``fsync=``):
 
-- ``"record"``: flush + fsync after every append — survives power loss
-  per accepted batch; highest latency.
+- ``"record"``: every append is fsynced before it is *acknowledged* —
+  survives power loss per accepted batch; highest latency.
 - ``"tick"`` (default): flush per append (page cache — survives process
   death), fsync once per tick boundary — a power loss can lose at most
   the current in-flight tick, never a committed one.
 - ``"os"``: flush per append, no per-record/per-tick fsync — survives
   process death only; the OS decides when bytes hit disk (segment
   rotation still fsyncs the sealed file, whatever the policy).
+
+Pipelined commit (the asynchronous committer)
+---------------------------------------------
+
+With ``committer="thread"`` (the default) the dispatch path never
+touches the disk: ``append``/``append_group`` pickle the record, assign
+it a monotonically increasing **LSN** and an exact ``LogPosition``
+(offset bookkeeping is synchronous), enqueue the framed bytes on an
+in-memory commit queue, and return. A dedicated *committer* thread
+(``reflow-wal-committer``) drains the queue in LSN order and performs
+the ``write`` + ``flush`` + ``os.fsync`` syscalls, advancing two
+watermarks: *flushed* (written to the page cache — process-death
+durable) and *synced* (fsynced — power-loss durable). Callers gate
+acknowledgement on :meth:`wait_durable` / :meth:`when_durable`, so
+window N's framing, write and fsync all overlap window N+1's host merge
+and device dispatch. What ``wait_durable(lsn)`` guarantees per policy:
+
+========  =========================================================
+policy    ``wait_durable(lsn)`` returns once the frame is …
+========  =========================================================
+record    fsynced (power-loss durable)
+tick      fsynced at the covering tick barrier (power-loss durable)
+os        written + flushed (process-death durable; no fsync wait)
+========  =========================================================
+
+A record an appender has enqueued but the committer has not yet written
+is NOT yet process-death durable — which is exactly why every
+acknowledgement path gates on the watermarks above, and why a crash
+that loses queued frames loses only *unacknowledged* batches (the
+upstream re-sends; replay dedups). ``committer="inline"`` restores the
+fully synchronous pre-pipeline behavior — every frame is written and
+every barrier fsynced in the appending thread (the
+``REFLOW_BENCH_WALPIPE=1`` baseline).
 
 A crashed process may leave a torn final record (partial write). The
 read side (:func:`scan_wal`) tolerates exactly that: a bad frame at the
@@ -45,8 +78,8 @@ import threading
 import time
 import zlib
 from collections import deque
-from typing import (Deque, Dict, Iterable, List, NamedTuple, Optional,
-                    Tuple)
+from typing import (Callable, Deque, Dict, Iterable, List, NamedTuple,
+                    Optional, Tuple)
 
 from reflow_tpu.obs import trace as _trace
 
@@ -104,27 +137,49 @@ class WriteAheadLog:
 
     Latency accounting (``utils.metrics.summarize_wal``): every append
     and fsync wall is recorded in ``append_s`` / ``fsync_s``, and
-    ``appends`` / ``fsyncs`` / ``bytes_written`` count totals.
+    ``appends`` / ``fsyncs`` / ``bytes_written`` count totals. With the
+    threaded committer ``append_s`` measures the *dispatch-path* cost
+    (pickle + enqueue); the write/fsync syscall wall lands in
+    ``fsync_s`` on the committer.
 
     Thread safety + group commit (ROADMAP open item): appends are safe
     from concurrent threads, and under ``fsync="record"`` the fsync is a
-    classic *group commit* — a writer whose frame was already covered by
-    another writer's fsync (or by :meth:`append_group`'s single barrier
-    over a whole coalescing window) skips its own. ``group_sizes``
-    records how many appends each fsync covered; >1 means grouping
-    engaged (the serving frontend's coalescing window is the hot
-    producer of large groups).
+    classic *group commit* — the committer drains every pending frame
+    and durability request with ONE fsync, and a request already
+    covered by the durable watermark (rotation sealed it, or an earlier
+    fsync passed it) rides for free. ``group_sizes`` records how many
+    appends each fsync covered; >1 means grouping engaged (the serving
+    frontend's coalescing window is the hot producer of large groups).
+
+    Locking: ``self._lock`` (an RLock) guards all appender state — LSN
+    and offset bookkeeping, the commit queue, the watermarks. The
+    committer performs its syscalls with ``_lock`` RELEASED (holding
+    only ``_sync_lock``, which orders fsync/close against fd swaps), so
+    appends keep flowing during the disk wait; lock order is
+    ``_lock`` → ``_sync_lock``. Durable callbacks registered via
+    :meth:`when_durable` fire *under* ``_lock`` (in LSN order, on
+    whichever thread advanced the watermark) — callbacks may take their
+    own locks but must never call back into a lock that is held while
+    calling WAL methods (the serve frontend never holds its admission
+    lock across a WAL call, so WAL-lock → frontend-lock is a safe
+    order).
     """
 
     POLICIES = ("record", "tick", "os")
+    COMMITTERS = ("thread", "inline")
 
     def __init__(self, wal_dir: str, *, fsync: str = "tick",
-                 segment_bytes: int = 16 << 20):
+                 segment_bytes: int = 16 << 20,
+                 committer: str = "thread", crash=None):
         if fsync not in self.POLICIES:
             raise ValueError(f"fsync policy {fsync!r} not in {self.POLICIES}")
+        if committer not in self.COMMITTERS:
+            raise ValueError(
+                f"committer {committer!r} not in {self.COMMITTERS}")
         self.wal_dir = wal_dir
         self.fsync_policy = fsync
         self.segment_bytes = segment_bytes
+        self._crash = crash
         os.makedirs(wal_dir, exist_ok=True)
         segs = list_segments(wal_dir)
         #: torn tail repaired at open, if any (surfaced by recovery)
@@ -150,11 +205,57 @@ class WriteAheadLog:
         #: appends covered per fsync (group-commit effectiveness)
         self.group_sizes: Deque[int] = deque(maxlen=_METRIC_WINDOW)
         self._lock = threading.RLock()
+        #: orders the fsync/close syscalls against fd swaps (rotation,
+        #: close): any path that closes the fd takes it, so a file is
+        #: never closed mid-fsync. Lock order: ``_lock`` →
+        #: ``_sync_lock`` (the committer never takes ``_lock`` while
+        #: holding ``_sync_lock``)
+        self._sync_lock = threading.Lock()
         self._unsynced_appends = 0
-        #: (segment, offset) durably synced through — the group-commit
-        #: free-ride check compares a frame's end position against this
-        self._synced_pos = (self._seq, 0)
+        #: LSN watermarks, all process-local and monotonic:
+        #: ``_written_lsn`` — last LSN *assigned* (frame pickled +
+        #: enqueued; with the inline committer also written);
+        #: ``_flushed_lsn`` — written + flushed to the page cache
+        #: (process-death durable, the ``"os"`` gate);
+        #: ``_synced_lsn`` — fsynced (power-loss durable, the
+        #: ``"record"``/``"tick"`` gate and group-commit free-ride
+        #: check)
+        self._written_lsn = 0
+        self._flushed_lsn = 0
+        self._synced_lsn = 0
+        #: committer work queue, strictly FIFO == LSN order:
+        #: ("frame", bytes, lsn) | ("rotate", new_seq, cover_lsn) |
+        #: ("fsync", target_lsn, t_enqueued)
+        self._io_q: Deque[tuple] = deque()
+        #: gauge mirror of pending durability requests (lsn, t) — feeds
+        #: queue_depth()/durable_lag_s(); popped as the watermark passes
+        self._fsync_q: Deque[Tuple[int, float]] = deque()
+        #: (lsn, fn) continuations fired once lsn is durable (LSN order)
+        self._callbacks: Deque[Tuple[int,
+                                     Callable[[Optional[BaseException]],
+                                              None]]] = deque()
+        self._commit_cv = threading.Condition(self._lock)   # committer
+        self._durable_cv = threading.Condition(self._lock)  # waiters
+        self._closing = False
+        #: True while the committer is mid-batch (drain() barrier)
+        self._io_busy = False
+        self.committer_error: Optional[BaseException] = None
         self._open_segment()
+        #: highest segment seq the committer has finished opening
+        #: (thread-mode rotate() barrier)
+        self._rotated_seq = self._seq
+        self._committer: Optional[threading.Thread] = None
+        if committer == "thread":
+            self._committer = threading.Thread(
+                target=self._committer_loop, name="reflow-wal-committer",
+                daemon=True)
+            self._committer.start()
+
+    # -- crash seams (tests only) ------------------------------------------
+
+    def _crash_point(self, name: str) -> None:
+        if self._crash is not None:
+            self._crash.point(name)
 
     # -- write side --------------------------------------------------------
 
@@ -164,12 +265,54 @@ class WriteAheadLog:
         self._f.flush()
         self._offset = len(_MAGIC)
 
-    def _write_frame(self, record: Dict) -> Tuple[LogPosition,
-                                                  Tuple[int, int]]:
-        # caller holds self._lock; returns (position, end-of-frame mark)
-        t0 = time.perf_counter()
+    def _frame(self, record: Dict) -> bytes:
         payload = pickle.dumps(record, protocol=pickle.HIGHEST_PROTOCOL)
-        frame = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+        return _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+
+    def _append_frame(self, record: Dict) -> Tuple[LogPosition, int]:
+        # caller holds self._lock; returns (position, frame LSN)
+        if self._committer is not None:
+            return self._enqueue_frame(record)
+        return self._write_frame(record)
+
+    def _enqueue_frame(self, record: Dict) -> Tuple[LogPosition, int]:
+        # threaded committer: the dispatch path only pickles and does
+        # position/LSN bookkeeping — write+flush+fsync happen on the
+        # committer, strictly in enqueue (== LSN) order
+        t0 = time.perf_counter()
+        frame = self._frame(record)
+        pos = LogPosition(self._seq, self._offset)
+        self._offset += len(frame)
+        self.appends += 1
+        self._unsynced_appends += 1
+        self.bytes_written += len(frame)
+        self._written_lsn += 1
+        lsn = self._written_lsn
+        self._io_q.append(("frame", frame, lsn))
+        if self._offset >= self.segment_bytes:
+            # bookkeeping rotation: later frames get positions in the
+            # next segment; the committer performs the actual
+            # seal-fsync/close/open when it reaches this command
+            self._seq += 1
+            self._io_q.append(("rotate", self._seq, lsn))
+            self._offset = len(_MAGIC)
+        self._commit_cv.notify()
+        # the seam fires only once the enqueue is complete (committer
+        # woken): a crash "after enqueue" must not strand the frame in a
+        # queue nobody is draining
+        self._crash_point("wal_enqueue")
+        self.append_s.append(time.perf_counter() - t0)
+        if _trace.ENABLED:
+            _trace.evt("wal_append", t0, time.perf_counter() - t0,
+                       track="wal", args={"bytes": len(frame), "lsn": lsn})
+        return pos, lsn
+
+    def _write_frame(self, record: Dict) -> Tuple[LogPosition, int]:
+        # inline committer: frame + write + flush synchronously (the
+        # pre-pipeline behavior); caller holds self._lock
+        self._crash_point("wal_before_write")
+        t0 = time.perf_counter()
+        frame = self._frame(record)
         pos = LogPosition(self._seq, self._offset)
         self._f.write(frame)
         # page cache is the floor for every policy: a killed process
@@ -179,94 +322,383 @@ class WriteAheadLog:
         self.appends += 1
         self._unsynced_appends += 1
         self.bytes_written += len(frame)
+        self._written_lsn += 1
+        self._flushed_lsn = self._written_lsn
+        lsn = self._written_lsn
         self.append_s.append(time.perf_counter() - t0)
         if _trace.ENABLED:
-            dur = time.perf_counter() - t0
-            _trace.evt("wal_append", t0, dur, track="wal",
-                       args={"bytes": len(frame)})
-            _trace.wal_accum_add(dur)
-        end = (self._seq, self._offset)
+            _trace.evt("wal_append", t0, time.perf_counter() - t0,
+                       track="wal", args={"bytes": len(frame), "lsn": lsn})
+        self._crash_point("wal_after_write")
         if self._offset >= self.segment_bytes:
             self.rotate()
-        return pos, end
+        return pos, lsn
 
-    def append(self, record: Dict) -> LogPosition:
-        """Frame + append one record; returns its position. Honors the
-        ``"record"`` fsync policy (with group commit — see the class
-        docstring); ``"tick"`` batches the fsync into :meth:`note_tick`.
-        """
+    def append(self, record: Dict, *, wait: bool = True) -> LogPosition:
+        """Frame + append one record; returns its (exact) position.
+        Under ``"record"`` a durability request is enqueued for the
+        frame and (``wait=True``, the default) acknowledged only once
+        durable; ``wait=False`` returns immediately after the enqueue —
+        the caller gates on :meth:`wait_durable`/:meth:`when_durable`
+        with :meth:`last_lsn`. ``"tick"`` batches the fsync into
+        :meth:`note_tick`."""
         with self._lock:
-            pos, end = self._write_frame(record)
-        if self.fsync_policy == "record":
-            self._record_fsync(end)
+            self._raise_if_committer_dead()
+            pos, lsn = self._append_frame(record)
+            if self.fsync_policy == "record":
+                self._request_durable(lsn)
+        if wait and self.fsync_policy == "record":
+            self.wait_durable(lsn)
         return pos
 
-    def append_group(self, records: Iterable[Dict]) -> List[LogPosition]:
+    def append_group(self, records: Iterable[Dict], *, wait: bool = True,
+                     request: bool = True) -> List[LogPosition]:
         """Append several records under ONE durability barrier: the
         explicit group-commit path for a coalescing window whose batches
         commit atomically anyway (``DurableScheduler.tick_many``). Under
-        ``"record"`` the group shares a single fsync."""
-        with self._lock:
-            out = [self._write_frame(r) for r in records]
-        if out and self.fsync_policy == "record":
-            self._record_fsync(out[-1][1])
-        return [pos for pos, _end in out]
+        ``"record"`` the group shares a single fsync. An empty group is
+        a complete no-op — no write, no fsync, no positions.
 
-    def _record_fsync(self, end: Tuple[int, int]) -> None:
-        # group commit: the first writer to reach the lock fsyncs for
-        # every frame written so far; a writer whose frame is already
-        # covered (rotation sealed it, or another writer's fsync passed
-        # it) takes the free ride
+        ``request=False`` skips even the durability *request*: the
+        caller is about to append a later group in the same logical
+        commit (data before markers) and wants one barrier for the
+        whole window, not one per group. The caller owns the follow-up
+        — it must issue a request (or an explicit ``wait_durable``)
+        covering these frames before acknowledging anything."""
+        records = list(records)
+        if not records:
+            return []
         with self._lock:
-            if self._synced_pos >= end:
-                return
-            self._fsync()
+            self._raise_if_committer_dead()
+            out = [self._append_frame(r) for r in records]
+            lsn = out[-1][1]
+            if request and self.fsync_policy == "record":
+                self._request_durable(lsn)
+        if wait and request and self.fsync_policy == "record":
+            self.wait_durable(lsn)
+        return [pos for pos, _lsn in out]
+
+    # -- durability pipeline ----------------------------------------------
+
+    def last_lsn(self) -> int:
+        """LSN of the most recently appended frame (0 = nothing yet).
+        Monotonic within this process — replay does not persist it."""
+        with self._lock:
+            return self._written_lsn
+
+    def _durable_point(self) -> int:
+        # caller holds self._lock: the watermark the current policy's
+        # durability promise gates on
+        if self.fsync_policy == "os":
+            return self._flushed_lsn
+        return self._synced_lsn
+
+    def durable_lsn(self) -> int:
+        """Highest LSN the policy's durability promise already covers."""
+        with self._lock:
+            return self._durable_point()
+
+    def queue_depth(self) -> int:
+        """Committer backlog: frames + barriers awaiting the committer
+        thread (0 with the inline committer — nothing is deferred)."""
+        with self._lock:
+            return len(self._io_q)
+
+    def durable_lag_s(self) -> float:
+        """Age of the oldest pending durability request (0.0 when the
+        committer is caught up)."""
+        with self._lock:
+            if not self._fsync_q:
+                return 0.0
+            return time.perf_counter() - self._fsync_q[0][1]
+
+    def _raise_if_committer_dead(self) -> None:
+        # caller holds self._lock — fail fast instead of accepting
+        # appends whose write/fsync no one will ever serve
+        if self.committer_error is not None:
+            raise self.committer_error
+
+    def _request_durable(self, lsn: int) -> None:
+        # caller holds self._lock: hand the barrier to the committer,
+        # or serve it inline when there is none
+        if self._committer is None:
+            if self._synced_lsn < lsn:
+                self._fsync()
+            return
+        now = time.perf_counter()
+        self._io_q.append(("fsync", lsn, now))
+        self._fsync_q.append((lsn, now))
+        self._commit_cv.notify()
+
+    def wait_durable(self, lsn: int) -> None:
+        """Block until ``lsn`` is covered by the policy's durability
+        promise (see the module docstring table). Raises the committer's
+        death cause if the write/fsync can no longer happen."""
+        if lsn <= 0:
+            return
+        with self._lock:
+            if self._committer is None and self._durable_point() < lsn:
+                if self.fsync_policy != "os":
+                    self._fsync()
+            while self._durable_point() < lsn:
+                if self.committer_error is not None:
+                    raise self.committer_error
+                self._durable_cv.wait()
+
+    def when_durable(self, lsn: int,
+                     fn: Callable[[Optional[BaseException]], None]) -> bool:
+        """Register a continuation for ``lsn``: returns False when the
+        LSN is already durable (the caller runs its continuation
+        inline); otherwise ``fn(None)`` fires once the watermark passes
+        it — in LSN order, under the WAL lock, on the thread that
+        advanced the watermark — or ``fn(error)`` if the committer dies
+        first. The serve frontend's deferred ticket resolution hangs off
+        this seam."""
+        with self._lock:
+            if self.committer_error is not None:
+                raise self.committer_error
+            if lsn <= self._durable_point():
+                return False
+            self._callbacks.append((lsn, fn))
+            return True
+
+    def _fire_due_callbacks(self) -> None:
+        # caller holds self._lock; a watermark just advanced
+        point = self._durable_point()
+        while self._callbacks and self._callbacks[0][0] <= point:
+            _lsn, fn = self._callbacks.popleft()
+            fn(None)
+
+    def _advance_synced(self, cover: int) -> None:
+        # caller holds self._lock
+        self._synced_lsn = cover
+        while self._fsync_q and self._fsync_q[0][0] <= cover:
+            self._fsync_q.popleft()
+        self._durable_cv.notify_all()
+        self._fire_due_callbacks()
+
+    def drain(self) -> None:
+        """Block until the committer has performed every write and
+        rotation enqueued so far (NO fsync barrier — use :meth:`sync`
+        for that): afterwards the on-disk log matches what a process
+        death at this instant would leave behind. A no-op with the
+        inline committer, where nothing is ever deferred."""
+        with self._lock:
+            if self._io_q:
+                self._commit_cv.notify()  # defensive wakeup
+            while self._io_q or self._io_busy:
+                if self.committer_error is not None:
+                    raise self.committer_error
+                self._durable_cv.wait()
+
+    def _committer_loop(self) -> None:
+        try:
+            while True:
+                with self._lock:
+                    self._io_busy = False
+                    self._durable_cv.notify_all()
+                    while not self._io_q and not self._closing:
+                        self._commit_cv.wait()
+                    if not self._io_q:
+                        return  # closing and caught up
+                    self._io_busy = True
+                    items = list(self._io_q)
+                    self._io_q.clear()
+                    f = self._f
+                # the syscalls below run with _lock RELEASED — appends,
+                # and the pump dispatching the next window through them,
+                # keep flowing while this thread blocks in the kernel.
+                # Only the committer writes in thread mode, so the fd is
+                # stable here except across its own rotate commands.
+                flushed_to = 0
+                sync_target = 0
+                for item in items:
+                    kind = item[0]
+                    if kind == "frame":
+                        _kind, data, lsn = item
+                        self._crash_point("wal_before_write")
+                        f.write(data)
+                        # page cache floor: flush per drain batch below
+                        flushed_to = lsn
+                        self._crash_point("wal_after_write")
+                    elif kind == "rotate":
+                        _kind, new_seq, cover = item
+                        f.flush()
+                        t0 = time.perf_counter()
+                        with self._sync_lock:
+                            os.fsync(f.fileno())
+                            f.close()
+                        f = open(_seg_path(self.wal_dir, new_seq), "wb")
+                        f.write(_MAGIC)
+                        f.flush()
+                        with self._lock:
+                            self._f = f
+                            self._rotated_seq = new_seq
+                            self.fsyncs += 1
+                            self.fsync_s.append(time.perf_counter() - t0)
+                            if flushed_to > self._flushed_lsn:
+                                self._flushed_lsn = flushed_to
+                            # bytes in a sealed segment are durable
+                            # whatever the policy
+                            if cover > self._synced_lsn:
+                                self._advance_synced(cover)
+                            else:
+                                self._durable_cv.notify_all()
+                    else:  # "fsync" durability request
+                        _kind, lsn, _t = item
+                        sync_target = max(sync_target, lsn)
+                if flushed_to:
+                    f.flush()
+                do_sync = False
+                with self._lock:
+                    if flushed_to > self._flushed_lsn:
+                        self._flushed_lsn = flushed_to
+                        self._durable_cv.notify_all()
+                        if self.fsync_policy == "os":
+                            self._fire_due_callbacks()
+                    if sync_target:
+                        self._crash_point("wal_before_fsync")
+                        if sync_target > self._synced_lsn:
+                            # snapshot: every frame <= cover is written+
+                            # flushed to fd ``f``, so an fsync started
+                            # after this point durably covers them all
+                            do_sync = True
+                            cover = self._flushed_lsn
+                            n = self._unsynced_appends
+                            self._unsynced_appends = 0
+                        else:
+                            # free ride: a rotation seal or an earlier
+                            # fsync already covered this barrier
+                            self._crash_point("wal_after_fsync")
+                if not do_sync:
+                    continue
+                t0 = time.perf_counter()
+                with self._sync_lock:
+                    if not f.closed:
+                        os.fsync(f.fileno())
+                dur = time.perf_counter() - t0
+                with self._lock:
+                    self.fsyncs += 1
+                    self.fsync_s.append(dur)
+                    if _trace.ENABLED:
+                        _trace.evt("wal_fsync", t0, dur,
+                                   track="wal-committer",
+                                   args={"covered": n,
+                                         "queue_depth": len(self._io_q)})
+                    if n:
+                        self.group_sizes.append(n)
+                    if cover > self._synced_lsn:
+                        self._advance_synced(cover)
+                    self._crash_point("wal_after_fsync")
+        except BaseException as e:  # noqa: BLE001 - incl. CrashPoint kills
+            with self._lock:
+                self.committer_error = e
+                self._io_busy = False
+                self._io_q.clear()
+                self._fsync_q.clear()
+                cbs = list(self._callbacks)
+                self._callbacks.clear()
+                self._durable_cv.notify_all()
+            for _lsn, fn in cbs:
+                fn(e)
 
     def _fsync(self) -> None:
-        # caller holds self._lock
+        # inline barrier — caller holds self._lock AND owns a drained
+        # log (inline committer always; thread mode only after the
+        # committer has exited or via the close path), so everything
+        # appended is written+flushed and this fsync covers through
+        # _written_lsn. The _sync_lock round-trip serializes against a
+        # committer fsync in flight on the same fd.
         t0 = time.perf_counter()
-        os.fsync(self._f.fileno())
+        with self._sync_lock:
+            os.fsync(self._f.fileno())
         self.fsyncs += 1
         self.fsync_s.append(time.perf_counter() - t0)
         if _trace.ENABLED:
-            dur = time.perf_counter() - t0
-            _trace.evt("wal_fsync", t0, dur, track="wal",
-                       args={"covered": self._unsynced_appends})
-            _trace.wal_accum_add(dur)
+            _trace.evt("wal_fsync", t0, time.perf_counter() - t0,
+                       track="wal",
+                       args={"covered": self._unsynced_appends,
+                             "queue_depth": len(self._io_q)})
         if self._unsynced_appends:
             self.group_sizes.append(self._unsynced_appends)
             self._unsynced_appends = 0
-        self._synced_pos = max(self._synced_pos, (self._seq, self._offset))
+        self._flushed_lsn = self._written_lsn
+        self._advance_synced(self._written_lsn)
 
-    def note_tick(self) -> None:
-        """Tick-boundary durability barrier (``"tick"`` policy fsyncs
-        here; ``"record"`` already did; ``"os"`` never does)."""
-        if self.fsync_policy == "tick":
-            with self._lock:
-                self._fsync()
+    def note_tick(self, *, wait: bool = True) -> None:
+        """Tick-boundary durability barrier (``"tick"`` policy requests
+        its fsync here; ``"record"`` already did; ``"os"`` never does).
+        Skipped entirely when nothing was appended since the last
+        barrier — an idle tick must not pay a no-op fsync."""
+        if self.fsync_policy != "tick":
+            return
+        with self._lock:
+            self._raise_if_committer_dead()
+            if self._synced_lsn >= self._written_lsn:
+                return
+            lsn = self._written_lsn
+            self._request_durable(lsn)
+        if wait:
+            self.wait_durable(lsn)
 
     def sync(self) -> None:
-        """Unconditional durability barrier (checkpoint path)."""
+        """Unconditional durability barrier (checkpoint path): blocks
+        until everything appended so far is written AND fsynced,
+        whatever the policy."""
         with self._lock:
+            if self._committer is not None:
+                self._raise_if_committer_dead()
+                lsn = self._written_lsn
+                if self._synced_lsn >= lsn:
+                    return
+                now = time.perf_counter()
+                self._io_q.append(("fsync", lsn, now))
+                self._fsync_q.append((lsn, now))
+                self._commit_cv.notify()
+                while self._synced_lsn < lsn:
+                    if self.committer_error is not None:
+                        raise self.committer_error
+                    self._durable_cv.wait()
+                return
             self._f.flush()
             self._fsync()
 
     def position(self) -> LogPosition:
-        """Position one past the last appended byte."""
+        """Position one past the last appended byte (exact even while
+        frames are still queued for the committer — offsets are
+        assigned at append time)."""
         with self._lock:
             return LogPosition(self._seq, self._offset)
 
     def rotate(self) -> None:
         """Seal the current segment and open the next one. The sealed
         segment is fsynced before close — whatever the policy, bytes in
-        a sealed segment are durable (so the group-commit free-ride
-        check can trust ``_synced_pos`` across rotations, and a
-        mid-tick rotation can't strand committed records in the page
-        cache)."""
+        a sealed segment are durable (so the free-ride check can trust
+        the durable watermark across rotations, and a mid-tick rotation
+        can't strand committed records in the page cache). With the
+        threaded committer this enqueues a rotate command and blocks
+        until the committer has performed it (FIFO order keeps every
+        already-queued frame in the old segment)."""
         with self._lock:
+            if self._committer is not None:
+                self._raise_if_committer_dead()
+                self._seq += 1
+                new_seq = self._seq
+                self._io_q.append(("rotate", new_seq, self._written_lsn))
+                self._offset = len(_MAGIC)
+                self._commit_cv.notify()
+                while self._rotated_seq < new_seq:
+                    if self.committer_error is not None:
+                        raise self.committer_error
+                    self._durable_cv.wait()
+                return
             self._f.flush()
             self._fsync()
-            self._f.close()
+            # the close rides the same mutex: a committer fsync holding
+            # a stale snapshot of this fd must finish (or see .closed)
+            # before the fd number can be reused by the next segment
+            with self._sync_lock:
+                self._f.close()
             self._seq += 1
             self._open_segment()
 
@@ -284,7 +716,10 @@ class WriteAheadLog:
                         ) -> str:
         """Register this log's live summary (the ``summarize_wal``
         schema: append/fsync latency percentiles, group-commit shape)
-        as an obs metric source. Returns the source key."""
+        plus the committer pipeline gauges (``.queue_depth`` backlog of
+        frames + barriers, ``.durable_lag_s`` age of the oldest pending
+        durability request) as obs metric sources. Returns the source
+        key."""
         from reflow_tpu.obs import REGISTRY
         from reflow_tpu.utils.metrics import summarize_wal
         reg = registry if registry is not None else REGISTRY
@@ -292,14 +727,36 @@ class WriteAheadLog:
                             lambda: summarize_wal(self).to_dict())
         reg.gauge(f"{name}.fsync_rate",
                   lambda: self.fsyncs / max(self.appends, 1))
+        reg.gauge(f"{name}.queue_depth", self.queue_depth)
+        reg.gauge(f"{name}.durable_lag_s", self.durable_lag_s)
         return name
 
     def close(self) -> None:
+        # stop the committer first: it drains every queued frame and
+        # barrier (firing their continuations) before exiting, so no
+        # ticket is stranded by a clean shutdown
+        committer = self._committer
+        if committer is not None:
+            with self._lock:
+                self._closing = True
+                self._commit_cv.notify_all()
+            committer.join(timeout=30.0)
+            self._committer = None
         with self._lock:
             if self._f is not None and not self._f.closed:
                 self._f.flush()
-                self._fsync()
-                self._f.close()
+                # seal-time idle skip: only fsync when bytes were
+                # appended since the last durability barrier
+                if self._synced_lsn < self._written_lsn \
+                        or self._unsynced_appends:
+                    self._fsync()
+                with self._sync_lock:
+                    self._f.close()
+            # a committer that died mid-pipeline already failed its
+            # callbacks; a clean close must not strand any either
+            if self._callbacks:
+                self._fire_due_callbacks()
+                self._callbacks.clear()
 
 
 # -- read side -------------------------------------------------------------

@@ -18,6 +18,15 @@ scheduler in two moves:
 Pushes logged after the last tick marker (a crash between ``push`` and
 ``tick``) land back in the pending buffers, exactly where the crash
 left them; the next ``tick()`` folds them once.
+
+The asynchronous WAL committer changes nothing here: a crash between a
+frame's write and its fsync may leave the scan seeing records whose
+submitters were never acknowledged (their tickets were still gated on
+``wal.wait_durable``). Replaying them is safe — replay is idempotent,
+and the upstream's re-send of the unacknowledged batch dedups against
+the replayed ``batch_id``. Conversely a power loss may drop
+written-but-unfsynced frames entirely; those batches were never
+acknowledged either, so the re-send folds them exactly once.
 """
 
 from __future__ import annotations

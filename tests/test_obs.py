@@ -120,6 +120,9 @@ def test_export_tracks_and_event_shape(tmp_path, traced):
               if e.get("ph") == "M" and e["name"] == "thread_name"}
     assert names == {"thread_name"}
     assert "wal" in tracks
+    # the pipelined fsync runs (and is recorded) on the committer's own
+    # timeline, not the pump's
+    assert "wal-committer" in tracks
     assert any(t.startswith("ticket/") for t in tracks)
     for e in evs:
         if e.get("ph") == "X":
@@ -274,6 +277,28 @@ def test_scheduler_and_wal_publish(tmp_path):
     sched.wal.close()
 
 
+def test_wal_pipeline_gauges_publish(tmp_path):
+    """The committer-pipeline gauges: ``queue_depth`` is the in-memory
+    commit backlog, ``durable_lag_s`` the age of the oldest pending
+    durability request — both drop to zero once a barrier lands."""
+    reg = obs.MetricsRegistry()
+    g, src, _sink = wordcount.build_graph()
+    sched = DurableScheduler(g, wal_dir=str(tmp_path / "wal"),
+                             fsync="tick")
+    wkey = sched.wal.publish_metrics(reg)
+    sched.push(src, lines("a", "b"))
+    sched.tick()
+    snap = reg.snapshot()
+    assert snap["gauges"][f"{wkey}.queue_depth"] >= 0
+    assert snap["gauges"][f"{wkey}.durable_lag_s"] >= 0.0
+    sched.wal.sync()  # policy-independent barrier: backlog fully lands
+    snap2 = reg.snapshot()
+    assert snap2["gauges"][f"{wkey}.queue_depth"] == 0
+    assert snap2["gauges"][f"{wkey}.durable_lag_s"] == 0.0
+    json.dumps(snap2)
+    sched.wal.close()
+
+
 # -- shared percentile + to_dict round-trips --------------------------------
 
 def test_percentile_empty_and_single():
@@ -334,8 +359,16 @@ def test_trace_inspect_cli(tmp_path, traced, capsys):
     assert out["tickets"] > 0
     assert out["decomposition_max_dev_frac"] < 0.10
     assert set(out["critical_path"]) == set(trace_mod.STAGES)
+    # default committer="thread": the durability split must see every
+    # fsync off the dispatch path
+    dur = out["durability"]
+    assert dur["offpath_fsyncs"] > 0 and dur["onpath_fsyncs"] == 0
+    assert dur["offpath_fsync_frac"] == 1.0
+    assert dur["fsync_covered_mean"] >= 1.0
     assert ti.main([path]) == 0  # human mode renders too
-    assert "critical path:" in capsys.readouterr().out
+    human = capsys.readouterr().out
+    assert "critical path:" in human
+    assert "off the dispatch path" in human
 
 
 def test_wal_inspect_json_schema(tmp_path, capsys):

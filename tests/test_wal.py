@@ -179,6 +179,8 @@ def test_crash_recovery_differential(tmp_path, seed):
     with pytest.raises(CrashPoint):
         drive(sched, src, feed)
         raise CrashPoint("end-of-feed")  # feed exhausted before the kill
+    sched.wal.drain()  # settle the committer: frames enqueued before the
+    # "kill" land in the page cache, as a real death would leave them
     if crash.fired and rng.random() < 0.5:
         tear_wal_tail(wal_dir, int(rng.integers(1, 24)))
 
@@ -204,6 +206,7 @@ def test_crash_at_each_seam(tmp_path, seam):
     sched = DurableScheduler(g, wal_dir=wal_dir, fsync="tick", crash=crash)
     with pytest.raises(CrashPoint):
         drive(sched, src, feed)
+    sched.wal.drain()  # deterministic page-cache state for the replay
     g2, src2, sink2 = wordcount.build_graph()
     sched2 = DurableScheduler(g2, wal_dir=wal_dir, fsync="tick")
     recover(sched2, wal_dir)
@@ -241,6 +244,7 @@ def test_checkpoint_plus_tail_recovery(tmp_path, seed):
                        for s, _p in list_segments(wal_dir))
         if t == ckpt_at + 2:
             break  # simulated kill two ticks after the save
+    sched.wal.drain()
     if rng.random() < 0.5:
         tear_wal_tail(wal_dir, int(rng.integers(1, 16)))
 
@@ -425,6 +429,7 @@ def test_append_group_rotation_mid_window_atomic_replay(tmp_path):
         feed_ids.append({src: [f"t{t}a", f"t{t}b"]})
     with pytest.raises(CrashPoint):
         sched.tick_many(feeds, feed_ids=feed_ids)
+    sched.wal.drain()  # the enqueued window + its rotations hit disk
     segs = list_segments(wal_dir)
     assert len(segs) > 1, "window did not span a rotation; shrink segments"
     # the "tick" policy alone would have fsynced NOTHING yet (no tick
@@ -511,3 +516,141 @@ def test_coalesced_batch_ids_replay_all_or_nothing(tmp_path):
     report2 = recover(again, str(tmp_path / "wal"))
     again.close()
     assert report2.deduped_pushes >= 1
+
+
+# -- asynchronous committer pipeline ---------------------------------------
+
+PIPELINE_SEAMS = ["wal_enqueue", "wal_before_write", "wal_after_write",
+                  "wal_before_fsync", "wal_after_fsync"]
+
+
+@pytest.mark.parametrize("seam", PIPELINE_SEAMS)
+def test_committer_seam_crash_replays_exactly_once(tmp_path, seam):
+    """Kill the durability pipeline at each of its own seams — frame
+    enqueued but not written, written but not fsynced, fsynced but the
+    acknowledgement path dead — then recover and resume from the
+    upstream cursor: the sink view matches the clean run, nothing folds
+    twice. ``wal_enqueue`` dies on the appending thread; the other four
+    kill the committer itself, and the death must surface as the
+    original CrashPoint from the next append/wait."""
+    import contextlib
+
+    feed = make_feed(7)
+    want = clean_run(feed)
+    wal_dir = str(tmp_path / seam)
+    g, src, sink = wordcount.build_graph()
+    crash = CrashInjector(3, only=seam)
+    sched = DurableScheduler(g, wal_dir=wal_dir, fsync="record",
+                             crash=crash)
+    with pytest.raises(CrashPoint):
+        drive(sched, src, feed)
+    assert crash.fired
+    with contextlib.suppress(CrashPoint):
+        # settle surviving writes; a dead committer re-raises its cause
+        sched.wal.drain()
+
+    g2, src2, sink2 = wordcount.build_graph()
+    sched2 = DurableScheduler(g2, wal_dir=wal_dir, fsync="record")
+    recover(sched2, wal_dir)
+    resume_from_cursor(sched2, src2, feed)
+    assert dict(sched2.view(sink2.name)) == want
+
+
+def test_committer_death_fails_waiters_and_callbacks(tmp_path):
+    """A committer that dies before the fsync must (a) fail every
+    registered ``when_durable`` continuation with its cause — no ticket
+    may hang unresolved — and (b) re-raise that cause from later
+    ``wait_durable``/``append`` calls instead of accepting writes it
+    can never commit."""
+    import threading
+
+    crash = CrashInjector(1, only="wal_before_fsync")
+    wal = WriteAheadLog(str(tmp_path), fsync="record", crash=crash)
+    b = wordcount.ingest_lines(["a b"])
+    rec = {"kind": "push", "tick": 0, "node": 0, "node_name": "w",
+           "batch_id": "b0", "keys": b.keys, "values": b.values,
+           "weights": b.weights}
+    got = []
+    fired = threading.Event()
+
+    wal.append(rec, wait=False)
+    lsn = wal.last_lsn()
+    try:
+        pending = wal.when_durable(
+            lsn, lambda err: (got.append(err), fired.set()))
+    except CrashPoint:
+        pending = False  # death already visible at registration time
+    if pending:
+        assert fired.wait(timeout=10.0), "continuation never resolved"
+        assert isinstance(got[0], CrashPoint)
+    with pytest.raises(CrashPoint):
+        wal.wait_durable(lsn)
+    with pytest.raises(CrashPoint):
+        wal.append(rec, wait=False)
+
+
+def test_drain_is_write_barrier_not_fsync_barrier(tmp_path):
+    """``drain()`` settles every enqueued frame into the segment file
+    (the scan sees them) without spending an fsync or moving the
+    durability watermark — the page-cache state a process death at that
+    instant would leave behind."""
+    wal = WriteAheadLog(str(tmp_path), fsync="tick")
+    b = wordcount.ingest_lines(["a b a"])
+    for j in range(3):
+        wal.append({"kind": "push", "tick": 0, "node": 0,
+                    "node_name": "w", "batch_id": f"b{j}",
+                    "keys": b.keys, "values": b.values,
+                    "weights": b.weights}, wait=False)
+    fsyncs0 = wal.fsyncs
+    wal.drain()
+    assert wal.queue_depth() == 0
+    records, torn = scan_wal(str(tmp_path))
+    assert torn is None and len(records) == 3
+    assert wal.fsyncs == fsyncs0          # no fsync spent
+    assert wal.durable_lsn() < wal.last_lsn()  # ...so not durable yet
+    wal.note_tick()
+    wal.wait_durable(wal.last_lsn())
+    assert wal.durable_lsn() == wal.last_lsn()
+    wal.close()
+
+
+def test_when_durable_fires_in_lsn_order(tmp_path):
+    """Continuations fire in LSN order once the watermark passes them,
+    each with ``None`` (success); already-durable LSNs report False so
+    the caller resolves inline."""
+    wal = WriteAheadLog(str(tmp_path), fsync="tick")
+    b = wordcount.ingest_lines(["x"])
+    lsns = []
+    for j in range(4):
+        wal.append({"kind": "push", "tick": 0, "node": 0,
+                    "node_name": "w", "batch_id": f"b{j}",
+                    "keys": b.keys, "values": b.values,
+                    "weights": b.weights}, wait=False)
+        lsns.append(wal.last_lsn())
+    fired = []
+    for lsn in lsns:
+        assert wal.when_durable(lsn, lambda err, lsn=lsn:
+                                fired.append((lsn, err)))
+    wal.note_tick()
+    wal.wait_durable(lsns[-1])
+    assert fired == [(lsn, None) for lsn in lsns]
+    # the watermark already covers them now: registration declines
+    assert wal.when_durable(lsns[-1], lambda err: None) is False
+    wal.close()
+
+
+def test_idle_tick_and_seal_skip_fsync(tmp_path):
+    """An idle tick boundary (nothing appended since the last barrier)
+    and an already-durable seal must not pay a no-op fsync."""
+    wal = WriteAheadLog(str(tmp_path), fsync="tick")
+    b = wordcount.ingest_lines(["a b"])
+    wal.append({"kind": "push", "tick": 0, "node": 0, "node_name": "w",
+                "batch_id": "b0", "keys": b.keys, "values": b.values,
+                "weights": b.weights}, wait=False)
+    wal.note_tick()
+    n = wal.fsyncs
+    wal.note_tick()  # idle: watermark already covers every append
+    wal.note_tick()
+    assert wal.fsyncs == n
+    wal.close()      # seal with no new bytes: no extra fsync either
+    assert wal.fsyncs == n

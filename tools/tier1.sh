@@ -25,10 +25,10 @@ rc=${PIPESTATUS[0]}
 dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 echo DOTS_PASSED=$dots
 
-# regression floor: the suite passed 242 at the PR-3 baseline; a run
+# regression floor: the suite passed 278 at the PR-5 baseline; a run
 # below that means previously-green tests broke (or silently vanished),
 # even if pytest's own exit status reads clean.
-FLOOR=${TIER1_FLOOR:-242}
+FLOOR=${TIER1_FLOOR:-278}
 if [ "$dots" -lt "$FLOOR" ]; then
   echo "TIER1: DOTS_PASSED=$dots below floor $FLOOR"
   rc=4
@@ -89,6 +89,24 @@ print(f"TIER1 obs smoke: {r['sampled_tickets']} tickets decomposed "
       f"(max dev {100 * r['decomposition_max_dev_frac']:.2f}%), "
       f"{len(evs)} trace spans, overhead "
       f"{100 * r['obs_overhead_frac']:.2f}%")
+EOF
+fi
+
+# optional (RUN_BENCH=1): the walpipe-mode smoke — the asynchronous
+# durability pipeline: device-resident pre-imaged submissions under
+# fsync="record" must log with ZERO forced materialize readbacks, and
+# the pipelined committer must not be slower than the inline one.
+if [ "${RUN_BENCH:-0}" = "1" ] && [ $rc -eq 0 ]; then
+  REFLOW_BENCH_WALPIPE=1 REFLOW_BENCH_SMOKE=1 JAX_PLATFORMS=cpu \
+    timeout -k 10 300 python bench.py > /tmp/_t1_walpipe.json || rc=3
+  python - <<'EOF' || rc=3
+import json
+r = json.load(open("/tmp/_t1_walpipe.json"))
+assert r["zero_materialize_readbacks"], r
+assert r["pipelined_ge_inline"], r
+assert r["replay_view_matches"], r
+print(f"TIER1 walpipe smoke: {r['walpipe_speedup_16p']}x pipelined vs "
+      f"inline @16p, 0 log readbacks, replay ok")
 EOF
 fi
 exit $rc

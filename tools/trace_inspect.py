@@ -12,7 +12,12 @@ Input is the Chrome trace-event JSON written by
 has two halves:
 
 - **spans**: p50/p99/total for every named span across all tracks
-  (windows, ticks, WAL appends/fsyncs, device dispatches);
+  (windows, ticks, WAL appends/fsyncs, device dispatches), plus the
+  **durability pipeline** split: ``wal_fsync`` spans on the
+  ``wal-committer`` track ran off the dispatch path (the asynchronous
+  committer), spans on the ``wal`` track ran on it (inline barriers) —
+  ``offpath_fsync_frac`` is the share of fsync time the pipeline moved
+  off the pump, ``fsync_covered_mean`` the group-commit fan-in;
 - **tickets**: the sampled tickets' end-to-end latency decomposed into
   the six pipeline stages (admission → coalesce → sched_delay →
   execute → fsync → resolve), with the **critical path** — stages
@@ -47,10 +52,23 @@ def inspect(path: str) -> dict:
     events = load_events(path)
     by_name: dict = defaultdict(list)
     tracks = set()
+    # numeric tid -> track name, from the thread_name metadata events
+    tid_names = {ev.get("tid"): ev["args"]["name"] for ev in events
+                 if ev.get("ph") == "M"
+                 and ev.get("name") == "thread_name"}
+    fsync_on, fsync_off, covered = [], [], []
     for ev in events:
         if ev.get("ph") == "X":
             by_name[ev.get("name", "?")].append(float(ev.get("dur", 0.0)))
             tracks.add(ev.get("tid"))
+            if ev.get("name") == "wal_fsync":
+                dur = float(ev.get("dur", 0.0))
+                if tid_names.get(ev.get("tid")) == "wal-committer":
+                    fsync_off.append(dur)
+                    covered.append(
+                        float((ev.get("args") or {}).get("covered", 0)))
+                else:
+                    fsync_on.append(dur)
     spans = {
         name: {"count": len(durs),
                "p50_us": round(percentile(durs, 50), 3),
@@ -80,11 +98,23 @@ def inspect(path: str) -> dict:
         if t["e2e_us"] > 0:
             max_dev = max(max_dev, abs(t["sum_us"] - t["e2e_us"])
                           / t["e2e_us"])
+    fsync_total = sum(fsync_on) + sum(fsync_off)
+    durability = {
+        "onpath_fsyncs": len(fsync_on),
+        "offpath_fsyncs": len(fsync_off),
+        "onpath_fsync_ms": round(sum(fsync_on) / 1e3, 3),
+        "offpath_fsync_ms": round(sum(fsync_off) / 1e3, 3),
+        "offpath_fsync_frac": (round(sum(fsync_off) / fsync_total, 4)
+                               if fsync_total else 0.0),
+        "fsync_covered_mean": (round(sum(covered) / len(covered), 2)
+                               if covered else 0.0),
+    }
     return {
         "schema": "reflow.trace_inspect/1",
         "trace_file": path,
         "events": sum(len(d) for d in by_name.values()),
         "tracks": len(tracks),
+        "durability": durability,
         "spans": spans,
         "tickets": len(tickets),
         "ticket_e2e_p50_us": round(percentile(e2e, 50), 3),
@@ -103,6 +133,12 @@ def _print_human(s: dict) -> None:
     for name, d in s["spans"].items():
         print(f"{name:<16} {d['count']:>7} {d['p50_us']:>12.1f} "
               f"{d['p99_us']:>12.1f} {d['total_ms']:>10.2f}")
+    dur = s["durability"]
+    if dur["onpath_fsyncs"] or dur["offpath_fsyncs"]:
+        print(f"durability: {dur['offpath_fsyncs']} fsync(s) off the "
+              f"dispatch path ({dur['offpath_fsync_frac']:.0%} of fsync "
+              f"time), {dur['onpath_fsyncs']} inline; mean group "
+              f"coverage {dur['fsync_covered_mean']:.1f}")
     if not s["tickets"]:
         print("no sampled tickets in this trace "
               "(REFLOW_TRACE_SAMPLE too high, or no serve traffic)")
