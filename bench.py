@@ -65,6 +65,15 @@ Env knobs::
                                   recover) and hot/quiet-tenant QoS
                                   isolation (CPU-only, no tunnel)
     REFLOW_BENCH_TIER_BATCHES     micro-batches per producer (default 200)
+    REFLOW_BENCH_CONTROL=1        control mode instead: self-healing
+                                  ControlPlane under step load — a
+                                  hot-tenant surge browned out per-graph
+                                  (quiet sibling's admission p99 bounded,
+                                  recovery within the configured control
+                                  intervals after the surge ends) and a
+                                  pump-crash storm tripping the circuit
+                                  breaker then healing through half-open
+                                  unattended (CPU-only, no tunnel)
     REFLOW_BENCH_OBS=1            obs mode instead: tracing + telemetry
                                   overhead on the 16-producer serve
                                   protocol over a durable scheduler, obs
@@ -947,6 +956,207 @@ def run_tier_bench() -> dict:
     return out
 
 
+def run_control_bench() -> dict:
+    """Self-healing control-plane step-load scenario (docs/guide.md
+    "Control plane"), two phases, both under a LIVE ``ControlPlane``
+    thread (no manual intervention anywhere):
+
+    A. **hot-tenant surge** — a hot graph saturates its budget ceiling
+       while a quiet sibling keeps submitting. The controller must
+       brown out ONLY the surging graph (the quiet tenant's brownout
+       level stays 0 and its admission p99 stays bounded), and once the
+       surge stops, walk the hot graph back to its configured policy
+       within the analytic bound of control intervals (ladder rungs x
+       ``recover_intervals`` + drain slack);
+    B. **pump-crash storm** — every macro-tick of one graph crashes
+       (``StormInjector``): the controller's breaker must open after K
+       crashes (quarantining the graph while its sibling keeps
+       applying), then — once the storm ends — heal it through a
+       half-open probe back to closed, after which submissions apply
+       again.
+
+    Host-side CPU work (no tunnel protocol applies).
+    """
+    import threading
+
+    from bench_configs import control_scenario
+    from reflow_tpu.obs import MetricsRegistry
+    from reflow_tpu.scheduler import DirtyScheduler
+    from reflow_tpu.serve import (CoalesceWindow, ControlConfig,
+                                  ControlPlane, GraphConfig, SLOSpec,
+                                  ServeTier)
+    from reflow_tpu.utils.faults import StormInjector
+    from reflow_tpu.workloads import wordcount
+
+    smoke = os.environ.get("REFLOW_BENCH_SMOKE") == "1"
+    kn = control_scenario(smoke)
+    rows_per_batch = 8
+    window = CoalesceWindow(max_rows=4096, max_ticks=8,
+                            max_latency_s=0.005)
+    reg = MetricsRegistry()   # private: don't pollute the global obs
+
+    def make_lines(graph: int, producer: int, j: int) -> list:
+        rng = np.random.default_rng(
+            (graph * 101 + producer) * 100_003 + j)
+        return [" ".join(f"w{int(x)}"
+                         for x in rng.integers(0, 1000, rows_per_batch))]
+
+    out = dict(kn)
+
+    # -- phase A: hot-tenant surge, brownout confined to the offender -----
+    budget = kn["budget_bytes"]
+    tier = ServeTier(max_bytes=budget,
+                     pump_threads=kn["pump_threads"])
+    g, src, _sink = wordcount.build_graph()
+    hot = tier.register("hot", DirtyScheduler(g), GraphConfig(
+        weight=1.0, ceiling_bytes=budget // 2, window=window))
+    g2, src2, _sink2 = wordcount.build_graph()
+    quiet = tier.register("quiet", DirtyScheduler(g2), GraphConfig(
+        weight=4.0, floor_bytes=budget // 4, window=window))
+    slo = SLOSpec(budget_occupancy=kn["occupancy_slo"],
+                  breach_intervals=kn["breach_intervals"],
+                  recover_intervals=kn["recover_intervals"])
+    # BOTH graphs carry the same SLO: the quiet tenant staying at level
+    # 0 then proves per-graph confinement, not a missing spec
+    cp = ControlPlane(
+        tier, specs={"hot": slo, "quiet": slo},
+        config=ControlConfig(interval_s=kn["interval_s"]),
+        registry=reg).start()
+    stop = threading.Event()
+
+    def hammer(pid):
+        # fire-and-forget saturation; under brownout the submits turn
+        # into fast rejections/sheds, which IS the degraded mode
+        j = 0
+        while not stop.is_set():
+            try:
+                hot.submit(src, wordcount.ingest_lines(
+                    make_lines(7, pid, j)), timeout=0.2)
+            except Exception:  # noqa: BLE001 - saturation is the point
+                pass
+            j += 1
+
+    hammers = [threading.Thread(target=hammer, args=(pid,))
+               for pid in range(kn["hammers"])]
+    for t in hammers:
+        t.start()
+    quiet_n = kn["quiet_batches"]
+    hot_peak_level = 0
+    quiet_peak_level = 0
+    t0 = time.perf_counter()
+    for j in range(quiet_n):
+        quiet.submit(src2, wordcount.ingest_lines(
+            make_lines(6, 0, j))).result(timeout=30)
+        hot_peak_level = max(hot_peak_level, cp.level("hot"))
+        quiet_peak_level = max(quiet_peak_level, cp.level("quiet"))
+    surge_s = time.perf_counter() - t0
+    # -- step-load falling edge: surge ends; measure recovery in ticks --
+    stop.set()
+    for t in hammers:
+        t.join()
+    ticks_at_surge_end = cp.ticks
+    rungs = len(slo.ladder)
+    recovery_bound = (rungs * kn["recover_intervals"]
+                      + kn["recovery_slack_ticks"])
+    deadline = time.perf_counter() + 30
+    while cp.level("hot") > 0 and time.perf_counter() < deadline:
+        time.sleep(kn["interval_s"])
+    recovery_ticks = cp.ticks - ticks_at_surge_end
+    p99 = (float(np.percentile(quiet.frontend.admission_s, 99))
+           if quiet.frontend.admission_s else 0.0)
+    out["quiet_admission_p99_us"] = round(p99 * 1e6, 1)
+    out["quiet_p99_bounded"] = p99 < kn["quiet_p99_bound_s"]
+    out["hot_peak_brownout_level"] = hot_peak_level
+    out["quiet_peak_brownout_level"] = quiet_peak_level
+    out["only_hot_degraded"] = (hot_peak_level > 0
+                                and quiet_peak_level == 0
+                                and quiet.frontend.policy == "block")
+    out["hot_policy_after_recovery"] = hot.frontend.policy
+    out["recovery_ticks"] = recovery_ticks
+    out["recovery_bound_ticks"] = recovery_bound
+    out["recovered_within_bound"] = (
+        cp.level("hot") == 0 and hot.frontend.policy == "block"
+        and recovery_ticks <= recovery_bound)
+    out["brownouts_entered"] = reg.value("control.brownouts_entered", 0)
+    out["brownouts_exited"] = reg.value("control.brownouts_exited", 0)
+    out["quiet_rows_per_s_during_surge"] = round(
+        quiet_n * rows_per_batch / surge_s)
+    log(f"surge: hot browned to level {hot_peak_level}, quiet stayed "
+        f"level {quiet_peak_level} (p99 {p99 * 1e6:.0f}us, bounded="
+        f"{out['quiet_p99_bounded']}); recovered in {recovery_ticks} "
+        f"ticks (bound {recovery_bound})")
+    cp.stop()
+    tier.close()
+
+    # -- phase B: pump-crash storm -> breaker -> half-open heal -----------
+    storm = StormInjector(only="pool_window@stormy")
+    tier = ServeTier(max_bytes=budget, pump_threads=kn["pump_threads"],
+                     crash=storm)
+    g3, src3, _ = wordcount.build_graph()
+    stormy = tier.register("stormy", DirtyScheduler(g3),
+                           GraphConfig(window=window))
+    g4, src4, _ = wordcount.build_graph()
+    steady = tier.register("steady", DirtyScheduler(g4),
+                           GraphConfig(window=window))
+    reg2 = MetricsRegistry()
+    cp = ControlPlane(
+        tier,
+        config=ControlConfig(
+            interval_s=kn["interval_s"],
+            max_crashes=kn["max_crashes"],
+            crash_window_s=kn["crash_window_s"],
+            respawn_backoff_s=kn["respawn_backoff_s"],
+            respawn_backoff_max_s=kn["respawn_backoff_max_s"],
+            breaker_cooldown_s=kn["breaker_cooldown_s"],
+            breaker_cooldown_max_s=kn["breaker_cooldown_max_s"],
+            probe_intervals=kn["probe_intervals"]),
+        registry=reg2).start()
+    t0 = time.perf_counter()
+    deadline = t0 + 60
+    j = 0
+    while (cp.breaker_state("stormy") != "open"
+           and time.perf_counter() < deadline):
+        try:
+            stormy.submit(src3, wordcount.ingest_lines(
+                make_lines(5, 0, j)), timeout=0.1)
+        except Exception:  # noqa: BLE001 - failed/quarantined mid-storm
+            pass
+        j += 1
+        time.sleep(0.002)
+    open_s = time.perf_counter() - t0
+    out["breaker_opened"] = cp.breaker_state("stormy") == "open"
+    out["breaker_open_after_s"] = round(open_s, 3)
+    out["storm_crashes"] = storm.crashes
+    # the sibling keeps applying while the storm rages / is quarantined
+    sib = steady.submit(src4, wordcount.ingest_lines(
+        make_lines(4, 0, 0))).result(timeout=30)
+    out["sibling_applied_during_storm"] = sib.applied
+    # storm ends: the breaker must heal the graph unattended
+    storm.disarm()
+    t0 = time.perf_counter()
+    deadline = t0 + 60
+    while (cp.breaker_state("stormy") != "closed"
+           and time.perf_counter() < deadline):
+        time.sleep(kn["interval_s"])
+    heal_s = time.perf_counter() - t0
+    out["breaker_recovered"] = cp.breaker_state("stormy") == "closed"
+    out["breaker_heal_s"] = round(heal_s, 3)
+    out["breaker_probes"] = reg2.value("control.breaker_probes", 0)
+    out["respawns"] = reg2.value("control.respawns", 0)
+    post = stormy.submit(src3, wordcount.ingest_lines(
+        make_lines(5, 1, 0))).result(timeout=30)
+    out["post_recovery_applied"] = post.applied
+    out["pool_live_workers"] = tier.live_workers
+    log(f"storm: opened={out['breaker_opened']} after {open_s:.2f}s "
+        f"({storm.crashes} crashes), healed={out['breaker_recovered']} "
+        f"in {heal_s:.2f}s ({out['respawns']} respawns, "
+        f"{out['breaker_probes']} probes); post-recovery applied="
+        f"{post.applied}")
+    cp.stop()
+    tier.close()
+    return out
+
+
 # -- config 3 measurements -------------------------------------------------
 
 def run_pagerank_cpu(n_nodes: int, n_edges: int, churn: float, ticks: int,
@@ -1272,6 +1482,18 @@ def main() -> None:
             "metric": "tier_rows_per_s_4g_2threads",
             "value": out["tier_rows_per_s_4g_2threads"],
             "unit": "rows/s",
+            **out,
+        }, json_out)
+        return
+
+    if os.environ.get("REFLOW_BENCH_CONTROL") == "1":
+        # control mode is host-side CPU work — no tunnel, no subprocesses
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        out = run_control_bench()
+        _emit({
+            "metric": "control_quiet_admission_p99_us_during_surge",
+            "value": out["quiet_admission_p99_us"],
+            "unit": "us",
             **out,
         }, json_out)
         return

@@ -141,6 +141,45 @@ def _pad_batch(batch, rows: int):
         np.zeros(pad, np.int64), vals, np.zeros(pad, np.int64))])
 
 
+def control_scenario(smoke: bool) -> dict:
+    """Step-load knobs for bench.py's ``REFLOW_BENCH_CONTROL`` mode
+    (hot-tenant surge + pump-crash storm under a live ControlPlane).
+
+    One place for the scenario's shape so the bench and the tier-1
+    smoke assert against the same numbers. The budget is sized so the
+    hot tenant genuinely saturates its byte ceiling (wordcount
+    micro-batches are tiny); the control interval is fast enough that
+    recovery-in-intervals is measured in tens of milliseconds, not
+    seconds. ``recovery_slack_ticks`` pads the analytic recovery bound
+    (ladder rungs x recover_intervals) with the ticks the pool needs to
+    drain in-flight bytes after the surge stops."""
+    return {
+        "budget_bytes": 8 << 10,
+        "pump_threads": 2,
+        "interval_s": 0.005,
+        # hot tenant's SLO: occupancy of its ceiling, 2-interval breach
+        # confirm, 2-interval per-rung recovery hysteresis
+        "occupancy_slo": 0.6,
+        "breach_intervals": 2,
+        "recover_intervals": 2,
+        "hammers": 3,
+        "quiet_batches": 60 if smoke else 200,
+        # quiet tenant's admission p99 bound during the surge (same
+        # bound phase C of the tier bench enforces without a controller)
+        "quiet_p99_bound_s": 0.05,
+        "recovery_slack_ticks": 12,
+        # crash-storm breaker knobs (fast cooldowns: the bench proves
+        # the open -> half-open -> closed arc, not production pacing)
+        "max_crashes": 3,
+        "crash_window_s": 30.0,
+        "respawn_backoff_s": 0.0,
+        "respawn_backoff_max_s": 0.01,
+        "breaker_cooldown_s": 0.02,
+        "breaker_cooldown_max_s": 0.1,
+        "probe_intervals": 2,
+    }
+
+
 def _guard(log, name: str):
     def deco(fn):
         def wrapped(*a, **k):

@@ -141,6 +141,25 @@ class MetricsRegistry:
                 for k in [k for k in d if k.startswith(prefix)]:
                     del d[k]
 
+    def value(self, name: str, default: Any = None) -> Any:
+        """Read one counter or gauge by name (counters shadow gauges on
+        a name collision; ``default`` when neither exists or the gauge's
+        callable fails). The point-read the control plane and bench
+        assertions use — cheaper than a full :meth:`snapshot`, and the
+        gauge callable runs OUTSIDE the registry lock for the same
+        deadlock-hygiene reason snapshot's do."""
+        with self._lock:
+            c = self._counters.get(name)
+            g = self._gauges.get(name)
+        if c is not None:
+            return c.value
+        if g is None:
+            return default
+        try:
+            return g.value
+        except Exception:  # noqa: BLE001 - degrade like snapshot()
+            return default
+
     def snapshot(self) -> Dict[str, Any]:
         with self._lock:
             counters = {k: c.value for k, c in self._counters.items()}

@@ -13,6 +13,8 @@ tour.
 
 from .budget import AdmissionBudget, BudgetShare
 from .coalesce import CoalesceWindow, Feed, build_feeds
+from .control import (Autoscaler, BrownoutLadder, CircuitBreaker,
+                      ControlConfig, ControlPlane, SLOSpec)
 from .frontend import IngestFrontend
 from .queues import batch_nbytes
 from .tickets import (APPLIED, DEDUPED, REJECTED, SHED, FrontendClosed,
@@ -21,8 +23,9 @@ from .tier import GraphConfig, GraphHandle, ServeTier, dwrr_pick
 
 __all__ = [
     "APPLIED", "DEDUPED", "REJECTED", "SHED",
-    "AdmissionBudget", "BudgetShare", "CoalesceWindow", "Feed",
-    "FrontendClosed", "GraphConfig", "GraphHandle", "IngestFrontend",
-    "PumpCrashed", "ServeTier", "Ticket", "TicketResult",
-    "batch_nbytes", "build_feeds", "dwrr_pick",
+    "AdmissionBudget", "Autoscaler", "BrownoutLadder", "BudgetShare",
+    "CircuitBreaker", "CoalesceWindow", "ControlConfig", "ControlPlane",
+    "Feed", "FrontendClosed", "GraphConfig", "GraphHandle",
+    "IngestFrontend", "PumpCrashed", "SLOSpec", "ServeTier", "Ticket",
+    "TicketResult", "batch_nbytes", "build_feeds", "dwrr_pick",
 ]

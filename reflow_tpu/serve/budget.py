@@ -148,6 +148,46 @@ class AdmissionBudget:
         self._shares[name] = share
         return share
 
+    def resize(self, name: str, *, floor: Optional[int] = None,
+               ceiling: Optional[int] = None) -> BudgetShare:
+        """Live-retune one share's floor/ceiling under the owning lock —
+        the control plane's rebalancing actuator.
+
+        Validation matches :meth:`register`: the new floor must stay
+        reservable alongside every sibling's floor, and the ceiling must
+        stay within the total. Shrinking a floor returns its reservation
+        to the shared pool immediately (siblings' ``max_alone`` grows);
+        growing one re-checks reservability. A ceiling below the share's
+        CURRENT usage is legal: nothing is evicted, but no new admission
+        happens until usage drains back under it. Loosened constraints
+        may unblock parked producers, so every resize notifies room
+        budget-wide.
+        """
+        share = self._shares.get(name)
+        if share is None:
+            raise KeyError(f"no budget share {name!r}")
+        new_floor = share.floor if floor is None else floor
+        new_ceiling = share.ceiling if ceiling is None else ceiling
+        if not 0 <= new_floor <= new_ceiling:
+            raise ValueError(
+                f"need 0 <= floor <= ceiling, got floor={new_floor} "
+                f"ceiling={new_ceiling} for {name!r}")
+        if new_ceiling > self.total_bytes:
+            raise ValueError(
+                f"ceiling {new_ceiling} for {name!r} exceeds the "
+                f"{self.total_bytes}B budget")
+        reserved = sum(s.floor for s in self._shares.values()
+                       if s is not share)
+        if reserved + new_floor > self.total_bytes:
+            raise ValueError(
+                f"floor {new_floor} for {name!r} is not reservable: "
+                f"{reserved}B of the {self.total_bytes}B budget is "
+                f"already promised to other graphs")
+        share.floor = new_floor
+        share.ceiling = new_ceiling
+        self.notify_room()
+        return share
+
     def unregister(self, name: str) -> None:
         """Drop a share; any bytes it still holds return to the pool
         (its entries' tickets were already failed or applied)."""

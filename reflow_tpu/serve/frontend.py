@@ -168,6 +168,8 @@ class IngestFrontend:
         self.shed = 0
         self.ticks = 0
         self.pump_iterations = 0
+        #: times a failed frontend was re-armed (:meth:`revive`)
+        self.revives = 0
         # bounded reservoirs (most recent METRIC_WINDOW samples) — the
         # totals above are exact; only percentile inputs are windowed
         self.queue_depth_samples: Deque[int] = deque(maxlen=METRIC_WINDOW)
@@ -762,6 +764,15 @@ class IngestFrontend:
             self._executing = False
             stranded = self._queues.drain_all()
             self._queues.commit_executing()
+            # the stranded backlog never reached the scheduler: drop its
+            # ids from the dedup mirror (same reasoning as the shed
+            # path) so a re-send after revive() is admitted, not
+            # DEDUPED. The in-flight window's ids stay mirrored — they
+            # may have executed before the crash, and a re-send that
+            # turns out unapplied still dedups safely at replay.
+            for entries in stranded.values():
+                for e in entries:
+                    self._admitted.pop(e.batch_id, None)
             self._budget.notify_room()
             self._not_full.notify_all()
             self._work.notify_all()
@@ -774,3 +785,39 @@ class IngestFrontend:
             for e in entries:
                 if not e.ticket.done():
                     e.ticket._fail(crash)
+
+    def revive(self) -> None:
+        """Re-arm a failed frontend: ``"failed"`` → ``"running"`` — the
+        control plane's respawn actuator (callers can also use it by
+        hand). Only valid after :meth:`_on_pump_crash` settled the
+        graph: queues drained, budget released, every undecided ticket
+        failed — so the frontend is structurally identical to a freshly
+        registered one and new submissions flow immediately. Upstreams
+        re-send the batches whose tickets failed with
+        :class:`PumpCrashed`; a durable graph's replay dedups any that
+        actually executed.
+
+        Durability caveat: reviving is at-most-once for the CRASHED
+        window on a volatile graph (its deltas are gone); a durable
+        graph loses nothing acknowledged — unacknowledged batches are
+        the upstream's to re-send, same as process-crash recovery. If
+        the scheduler's WAL committer is dead this raises — call
+        ``wal.restart_committer()`` first, or the next window would
+        fail the graph right back."""
+        with self._lock:
+            if self._state != "failed":
+                raise GraphError(
+                    f"revive() re-arms a failed frontend; state is "
+                    f"{self._state!r}")
+            wal = getattr(self.sched, "wal", None)
+            if wal is not None and wal.committer_error is not None:
+                raise GraphError(
+                    "scheduler's WAL committer is dead; "
+                    "restart_committer() before revive()")
+            self._state = "running"
+            self.pump_error = None
+            self._executing = False
+            self.revives += 1
+            self._not_full.notify_all()
+            self._work.notify_all()
+            self._idle.notify_all()

@@ -120,6 +120,9 @@ class GraphHandle:
         self._ready_since: Optional[float] = None
         self.windows = 0
         self.rows_applied = 0
+        #: windows that crashed THIS graph (the control plane's
+        #: circuit-breaker input)
+        self.crashes = 0
         self.sched_delay_s: Deque[float] = deque(maxlen=METRIC_WINDOW)
 
     @property
@@ -172,12 +175,20 @@ class ServeTier:
         self._metric_keys: List = []
         self._t0 = time.perf_counter()
         self.pump_threads = pump_threads
-        self._threads = [
-            threading.Thread(target=self._pool_loop,
-                             name=f"reflow-tier-pump-{i}", daemon=True)
-            for i in range(pump_threads)]
-        for t in self._threads:
-            t.start()
+        # -- pool supervision state (under the tier lock) --
+        #: how many live workers the pool SHOULD have; the supervisor
+        #: (ensure_workers) respawns toward it, scale_pool retunes it
+        self._target_threads = pump_threads
+        #: workers asked to exit at their next loop top (scale-down)
+        self._retiring = 0
+        self._next_worker_id = 0
+        self.worker_deaths = 0
+        self.worker_respawns = 0
+        self.last_worker_error: Optional[BaseException] = None
+        self._threads: List[threading.Thread] = []
+        with self._lock:
+            for _ in range(pump_threads):
+                self._spawn_worker_locked()
 
     # -- registry ----------------------------------------------------------
 
@@ -297,6 +308,8 @@ class ServeTier:
         reg.gauge(f"{name}.budget_used_bytes", lambda: self.budget.used)
         reg.gauge(f"{name}.budget_occupancy",
                   lambda: self.budget.used / self.budget.total_bytes)
+        reg.gauge(f"{name}.live_workers", lambda: self.live_workers)
+        reg.gauge(f"{name}.worker_deaths", lambda: self.worker_deaths)
         self._metric_keys.append((reg, name))
         return name
 
@@ -309,71 +322,165 @@ class ServeTier:
             return 0.0
         return self._busy_s / (self.pump_threads * elapsed)
 
+    # -- pool supervision / elasticity -------------------------------------
+
+    def _spawn_worker_locked(self) -> None:
+        t = threading.Thread(
+            target=self._pool_loop,
+            name=f"reflow-tier-pump-{self._next_worker_id}", daemon=True)
+        self._next_worker_id += 1
+        self._threads.append(t)
+        t.start()
+
+    def _reap_locked(self) -> None:
+        self._threads = [t for t in self._threads if t.is_alive()]
+
+    @property
+    def live_workers(self) -> int:
+        """Pool workers currently alive (dead ones are respawned by
+        :meth:`ensure_workers`; retirees from a scale-down exit at their
+        next loop top)."""
+        return sum(1 for t in self._threads if t.is_alive())
+
+    def ensure_workers(self) -> int:
+        """Respawn dead pool workers back to the target size — the
+        supervision seam the control plane ticks. A worker that dies
+        (a bug escaping the per-window isolation, a deliberate
+        ``pool_worker@*`` seam) would otherwise shrink effective
+        parallelism for the life of the tier. Returns how many workers
+        were spawned (0 = pool already at target)."""
+        with self._lock:
+            if self._closed:
+                return 0
+            self._reap_locked()
+            spawned = 0
+            while (len(self._threads) - self._retiring
+                   < self._target_threads):
+                self._spawn_worker_locked()
+                spawned += 1
+            self.worker_respawns += spawned
+            return spawned
+
+    def scale_pool(self, target: int) -> int:
+        """Retune the pool to ``target`` workers — the autoscaling
+        actuator. Growing spawns immediately; shrinking marks the excess
+        to retire at their next loop top (never mid-window). Clamped to
+        at least 1. Returns the new target."""
+        with self._lock:
+            if self._closed:
+                return self._target_threads
+            target = max(1, int(target))
+            self._target_threads = target
+            self.pump_threads = target  # utilization denominator
+            self._reap_locked()
+            planned = len(self._threads) - self._retiring
+            if planned < target:
+                for _ in range(target - planned):
+                    self._spawn_worker_locked()
+            elif planned > target:
+                self._retiring += planned - target
+                self._work.notify_all()  # idle workers retire in wait()
+            return target
+
+    @property
+    def ready_depth(self) -> int:
+        """How many graphs have a fireable window RIGHT NOW (the
+        autoscaler's backlog signal; racy-but-fine telemetry)."""
+        with self._lock:
+            now = time.perf_counter()
+            return sum(1 for h in self._graphs.values()
+                       if h.frontend._poll(now)[0])
+
     # -- the pool ----------------------------------------------------------
 
     def _pool_loop(self) -> None:
-        while True:
+        # worker death (anything escaping _pool_iteration, including
+        # the per-window isolation handler itself failing) is recorded
+        # so the supervisor can respawn back to target — a silent exit
+        # here is the pool-capacity leak
+        try:
+            while self._pool_iteration():
+                pass
+        except BaseException as e:  # noqa: BLE001 - supervision boundary
             with self._lock:
-                picked = None
-                while picked is None:
-                    if self._closed:
-                        return
-                    now = time.perf_counter()
-                    ready: List[GraphHandle] = []
-                    wait_t: Optional[float] = None
-                    for h in self._graphs.values():
-                        fire, w = h.frontend._poll(now)
-                        if fire:
-                            if h._ready_since is None:
-                                h._ready_since = now
-                            ready.append(h)
-                        else:
-                            # not ready (or latched by a sibling
-                            # worker): the ready stretch is over
-                            h._ready_since = None
-                            if w is not None:
-                                wait_t = (w if wait_t is None
-                                          else min(wait_t, w))
-                    if ready:
-                        picked = dwrr_pick(ready, self.quantum_rows)
-                        ready_since = picked._ready_since
-                        picked.sched_delay_s.append(now - ready_since)
-                        picked._ready_since = None
-                        if _trace.ENABLED:
-                            _trace.evt("pool_pick", ready_since,
-                                       now - ready_since,
-                                       args={"graph": picked.name})
-                        drained = picked.frontend._take_window(
-                            ready_since=ready_since)
-                    else:
-                        self._work.wait(timeout=wait_t)
-            # -- macro-tick, unlocked (single-owner: the latch set by
-            # _take_window keeps every other worker off this graph) --
-            t0 = time.perf_counter()
-            crashed = False
-            try:
-                if self._crash is not None:
-                    self._crash.point(f"pool_window@{picked.name}")
-                picked.frontend._run_window(drained)
-            except BaseException as e:  # noqa: BLE001 - fault isolation
-                crashed = True
-                picked.frontend._on_pump_crash(e, window=drained)
-            busy = time.perf_counter() - t0
-            rows = sum(e.rows for entries in drained.values()
-                       for e in entries)
-            with self._lock:
-                self._busy_s += busy
-                self.windows += 1
-                picked.windows += 1
-                picked._deficit -= max(rows, 1)
-                if crashed:
-                    self.pool_crashes += 1
-                    # _on_pump_crash already released the latch, the
-                    # graph's bytes, and its blocked producers
-                else:
-                    picked.rows_applied += rows
-                    picked.frontend._finish_window()
-                # re-evaluate readiness pool-wide: the just-unlatched
-                # graph may have accrued backlog, and idle workers only
-                # wake on notify
+                self.worker_deaths += 1
+                self.last_worker_error = e
                 self._work.notify_all()
+
+    def _pool_iteration(self) -> bool:
+        # one pick + macro-tick; False = exit this worker (close/retire)
+        with self._lock:
+            picked = None
+            while picked is None:
+                if self._closed:
+                    return False
+                if self._retiring > 0:
+                    self._retiring -= 1
+                    return False
+                now = time.perf_counter()
+                ready: List[GraphHandle] = []
+                wait_t: Optional[float] = None
+                for h in self._graphs.values():
+                    fire, w = h.frontend._poll(now)
+                    if fire:
+                        if h._ready_since is None:
+                            h._ready_since = now
+                        ready.append(h)
+                    else:
+                        # not ready (or latched by a sibling
+                        # worker): the ready stretch is over
+                        h._ready_since = None
+                        if w is not None:
+                            wait_t = (w if wait_t is None
+                                      else min(wait_t, w))
+                if ready:
+                    picked = dwrr_pick(ready, self.quantum_rows)
+                    ready_since = picked._ready_since
+                    picked.sched_delay_s.append(now - ready_since)
+                    picked._ready_since = None
+                    if _trace.ENABLED:
+                        _trace.evt("pool_pick", ready_since,
+                                   now - ready_since,
+                                   args={"graph": picked.name})
+                    drained = picked.frontend._take_window(
+                        ready_since=ready_since)
+                else:
+                    self._work.wait(timeout=wait_t)
+        # -- macro-tick, unlocked (single-owner: the latch set by
+        # _take_window keeps every other worker off this graph) --
+        t0 = time.perf_counter()
+        crashed = False
+        try:
+            if self._crash is not None:
+                self._crash.point(f"pool_window@{picked.name}")
+            picked.frontend._run_window(drained)
+        except BaseException as e:  # noqa: BLE001 - fault isolation
+            crashed = True
+            picked.frontend._on_pump_crash(e, window=drained)
+        busy = time.perf_counter() - t0
+        rows = sum(e.rows for entries in drained.values()
+                   for e in entries)
+        with self._lock:
+            self._busy_s += busy
+            self.windows += 1
+            picked.windows += 1
+            picked._deficit -= max(rows, 1)
+            if crashed:
+                self.pool_crashes += 1
+                picked.crashes += 1
+                # _on_pump_crash already released the latch, the
+                # graph's bytes, and its blocked producers
+            else:
+                picked.rows_applied += rows
+                picked.frontend._finish_window()
+            # re-evaluate readiness pool-wide: the just-unlatched
+            # graph may have accrued backlog, and idle workers only
+            # wake on notify
+            self._work.notify_all()
+        # deliberate WORKER-death seam (vs pool_window@, which crashes
+        # the graph): fires between windows, after the graph is settled,
+        # so the only casualty is this thread — exactly the capacity
+        # leak the supervisor exists to heal
+        if self._crash is not None:
+            self._crash.point(f"pool_worker@{picked.name}")
+        return True

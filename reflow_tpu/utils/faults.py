@@ -33,7 +33,7 @@ from reflow_tpu.delta import DeltaBatch
 from reflow_tpu.graph import Node
 
 __all__ = ["CrashInjector", "CrashPoint", "DeliveryError", "FaultyChannel",
-           "tear_wal_tail"]
+           "StormInjector", "tear_wal_tail"]
 
 
 class DeliveryError(RuntimeError):
@@ -92,6 +92,40 @@ class CrashInjector:
                 self.fired = True
                 self.fired_seam = name
                 raise CrashPoint(name)
+
+
+class StormInjector:
+    """Raise :class:`CrashPoint` at EVERY visit of matching seams while
+    armed — a repeating crash storm, where :class:`CrashInjector` models
+    exactly one process death.
+
+    This is the circuit-breaker scenario: a graph whose every revival
+    crashes again (a poisoned batch, a broken kernel) must trip the
+    control plane's breaker instead of burning the pool in a
+    crash-respawn loop; :meth:`disarm` ends the storm so the breaker's
+    half-open probe can prove the graph healthy again. ``crashes``
+    counts the kills actually delivered."""
+
+    def __init__(self, only: str):
+        self.only = only
+        self.armed = True
+        self.crashes = 0
+        self.seams: List[str] = []
+        self._lock = threading.Lock()
+
+    def point(self, name: str) -> None:
+        with self._lock:
+            if not self.armed or self.only not in name:
+                return
+            self.crashes += 1
+            self.seams.append(name)
+        raise CrashPoint(name)
+
+    def disarm(self) -> None:
+        self.armed = False
+
+    def rearm(self) -> None:
+        self.armed = True
 
 
 def tear_wal_tail(wal_dir: str, cut_bytes: int) -> Optional[str]:

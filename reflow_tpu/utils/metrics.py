@@ -258,6 +258,11 @@ class TierMetrics:
     sched_delay_p50_s: float
     sched_delay_p99_s: float
     per_graph: dict
+    #: pool supervision view: workers alive now vs the scale target,
+    #: deaths recorded and respawns performed (control-plane healing)
+    live_workers: int = 0
+    worker_deaths: int = 0
+    worker_respawns: int = 0
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -293,6 +298,9 @@ def summarize_tier(tier) -> TierMetrics:
             sched_delay_p99_s=pct(h.sched_delay_s, 99),
             admission_p99_s=pct(fe.admission_s, 99),
             state=fe._state,
+            policy=fe.policy,
+            crashes=h.crashes,
+            revives=fe.revives,
         )
         per_graph[name] = g
         all_delays.extend(h.sched_delay_s)
@@ -309,6 +317,9 @@ def summarize_tier(tier) -> TierMetrics:
         sched_delay_p50_s=pct(all_delays, 50),
         sched_delay_p99_s=pct(all_delays, 99),
         per_graph=per_graph,
+        live_workers=tier.live_workers,
+        worker_deaths=tier.worker_deaths,
+        worker_respawns=tier.worker_respawns,
     )
 
 

@@ -240,6 +240,12 @@ class WriteAheadLog:
         #: True while the committer is mid-batch (drain() barrier)
         self._io_busy = False
         self.committer_error: Optional[BaseException] = None
+        #: supervision counters: how many times a dead committer was
+        #: respawned (:meth:`restart_committer`), and the cause of the
+        #: most recent death (kept after the error is cleared so the
+        #: control plane can report WHY it restarted)
+        self.committer_restarts = 0
+        self.last_committer_error: Optional[BaseException] = None
         self._open_segment()
         #: highest segment seq the committer has finished opening
         #: (thread-mode rotate() barrier)
@@ -430,12 +436,23 @@ class WriteAheadLog:
         self._fsync_q.append((lsn, now))
         self._commit_cv.notify()
 
-    def wait_durable(self, lsn: int) -> None:
+    def wait_durable(self, lsn: int,
+                     timeout: Optional[float] = None) -> None:
         """Block until ``lsn`` is covered by the policy's durability
         promise (see the module docstring table). Raises the committer's
-        death cause if the write/fsync can no longer happen."""
+        death cause if the write/fsync can no longer happen.
+
+        ``timeout`` (seconds) bounds the wait: on expiry a
+        :class:`TimeoutError` is raised WITHOUT consuming the durability
+        request — the committer keeps working, the frame may still
+        become durable later, and a re-wait on the same LSN can succeed.
+        This is the escape hatch for callers parked behind a wedged
+        committer (a disk stall, a dead fd) who would otherwise hang
+        forever."""
         if lsn <= 0:
             return
+        deadline = (None if timeout is None
+                    else time.perf_counter() + timeout)
         with self._lock:
             if self._committer is None and self._durable_point() < lsn:
                 if self.fsync_policy != "os":
@@ -443,7 +460,15 @@ class WriteAheadLog:
             while self._durable_point() < lsn:
                 if self.committer_error is not None:
                     raise self.committer_error
-                self._durable_cv.wait()
+                remaining = None
+                if deadline is not None:
+                    remaining = deadline - time.perf_counter()
+                    if remaining <= 0:
+                        raise TimeoutError(
+                            f"lsn {lsn} not durable after {timeout}s "
+                            f"(durable point {self._durable_point()}, "
+                            f"committer queue {len(self._io_q)})")
+                self._durable_cv.wait(timeout=remaining)
 
     def when_durable(self, lsn: int,
                      fn: Callable[[Optional[BaseException]], None]) -> bool:
@@ -601,6 +626,63 @@ class WriteAheadLog:
                 self._durable_cv.notify_all()
             for _lsn, fn in cbs:
                 fn(e)
+
+    def restart_committer(self) -> bool:
+        """Respawn a dead committer thread on a FRESH segment — the
+        control plane's respawn-or-fail-fast actuator. Returns True when
+        a restart happened (False: committer alive, inline mode, or the
+        log is closing).
+
+        Contract: committer death already failed every unacknowledged
+        frame — queued writes were dropped, ``when_durable`` callbacks
+        fired with the death cause, ``wait_durable`` waiters raised — so
+        from every caller's perspective those LSNs are settled losses,
+        exactly like a process crash losing unacknowledged batches
+        (upstream re-send + replay dedup carries exactly-once across
+        it). The restart therefore advances the durable watermarks to
+        the written watermark and starts clean: the on-disk log simply
+        never contains the lost frames. The old segment's tail is
+        repaired first (the dead committer may have torn a frame
+        mid-write), so sealed-segment scans stay valid."""
+        with self._lock:
+            if (self._committer is None or self._closing
+                    or self.committer_error is None):
+                return False
+            self.last_committer_error = self.committer_error
+            # seal best-effort and never append to the old fd again: a
+            # torn tail must stay in the OLD segment where repair can
+            # truncate it, same rule as a process restart
+            try:
+                with self._sync_lock:
+                    if self._f is not None and not self._f.closed:
+                        self._f.close()
+            except OSError:
+                pass
+            segs = list_segments(self.wal_dir)
+            if segs:
+                _repair_tail(segs[-1][1], segs[-1][0])
+                self._seq = segs[-1][0] + 1
+            else:
+                self._seq += 1
+            self._open_segment()
+            self._rotated_seq = self._seq
+            # settle the watermarks: nothing below _written_lsn can ever
+            # reach the disk now, and every such frame was already
+            # reported failed to its caller
+            self._flushed_lsn = self._written_lsn
+            self._synced_lsn = self._written_lsn
+            self._unsynced_appends = 0
+            self._io_q.clear()
+            self._fsync_q.clear()
+            self._io_busy = False
+            self.committer_error = None
+            self.committer_restarts += 1
+            self._committer = threading.Thread(
+                target=self._committer_loop, name="reflow-wal-committer",
+                daemon=True)
+            self._committer.start()
+            self._durable_cv.notify_all()
+            return True
 
     def _fsync(self) -> None:
         # inline barrier — caller holds self._lock AND owns a drained

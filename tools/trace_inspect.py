@@ -24,6 +24,14 @@ has two halves:
   ranked by their mean share of end-to-end latency — and the worst
   decomposition deviation (stage sums are tiled, so this should sit at
   ~0%; large values mean a clock or export bug).
+
+A trace recorded under a live ``ControlPlane`` also carries its
+actuations as zero-duration ``control.<action>`` spans on the
+``control`` track; the report surfaces them as **control actions** —
+counts per action (brownout steps/recoveries, respawns, breaker
+opens/probes/closes, scale events, floor reclaims) — so an operator can
+line the controller's interventions up against the data-path spans they
+reacted to.
 """
 
 from __future__ import annotations
@@ -98,6 +106,10 @@ def inspect(path: str) -> dict:
         if t["e2e_us"] > 0:
             max_dev = max(max_dev, abs(t["sum_us"] - t["e2e_us"])
                           / t["e2e_us"])
+    control_actions = {
+        name[len("control."):]: len(durs)
+        for name, durs in sorted(by_name.items())
+        if name.startswith("control.")}
     fsync_total = sum(fsync_on) + sum(fsync_off)
     durability = {
         "onpath_fsyncs": len(fsync_on),
@@ -115,6 +127,7 @@ def inspect(path: str) -> dict:
         "events": sum(len(d) for d in by_name.values()),
         "tracks": len(tracks),
         "durability": durability,
+        "control_actions": control_actions,
         "spans": spans,
         "tickets": len(tickets),
         "ticket_e2e_p50_us": round(percentile(e2e, 50), 3),
@@ -139,6 +152,10 @@ def _print_human(s: dict) -> None:
               f"dispatch path ({dur['offpath_fsync_frac']:.0%} of fsync "
               f"time), {dur['onpath_fsyncs']} inline; mean group "
               f"coverage {dur['fsync_covered_mean']:.1f}")
+    if s["control_actions"]:
+        acts = ", ".join(f"{k}={v}"
+                         for k, v in s["control_actions"].items())
+        print(f"control actions: {acts}")
     if not s["tickets"]:
         print("no sampled tickets in this trace "
               "(REFLOW_TRACE_SAMPLE too high, or no serve traffic)")
