@@ -52,6 +52,18 @@ Env knobs::
                                   fsync policy + time-to-first-tick after a
                                   simulated crash (CPU-only, no tunnel)
     REFLOW_BENCH_RECOVERY_TICKS   crash-backlog size  (default 1000)
+    REFLOW_BENCH_RECOVERY_TPU_TICKS  device-path crash backlog
+                                  (default backlog/10; the recovery mode
+                                  also replays over TpuExecutor to price
+                                  recompile-on-replay)
+    REFLOW_BENCH_MEGATICK=1       mega-tick mode instead: the PageRank
+                                  churn window fused into ONE compiled
+                                  dispatch (tick_many -> run_window over
+                                  the device-resident ingress queue),
+                                  reporting tick_s_amortized vs
+                                  window_dispatch_s plus view parity
+                                  against an identically-fed per-tick
+                                  twin (runs on the selected device)
     REFLOW_BENCH_SERVE=1          serve mode instead: IngestFrontend
                                   sustained throughput at 1/4/16 concurrent
                                   producers vs the bare push+tick loop,
@@ -262,6 +274,190 @@ def run_recovery_bench() -> dict:
         log("recovery:", json.dumps(report.as_dict()))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+
+    # 3. Device-path recovery: the same crash protocol over the jit
+    #    executor (TpuExecutor), where replay re-executes through compiled
+    #    programs — the first replayed tick pays the recompile, the rest
+    #    stream. Records the post-crash first-tick and the backlog-drain
+    #    (replay) wall on the device path, next to the host-oracle numbers
+    #    above. Runs on whatever backend JAX_PLATFORMS selects (the mode
+    #    defaults to cpu), so by default this measures the jit/recompile
+    #    cost, not tunnel transport.
+    from reflow_tpu import FlowGraph
+    from reflow_tpu.delta import DeltaBatch, Spec
+    from reflow_tpu.executors import get_executor
+
+    tpu_backlog = int(os.environ.get(
+        "REFLOW_BENCH_RECOVERY_TPU_TICKS", str(max(8, backlog // 10))))
+
+    def build_dev():
+        g = FlowGraph("recovery_dev")
+        src = g.source("s", Spec((), np.float32, key_space=64))
+        red = g.reduce(src, "sum", tol=0.0)
+        return g, src, red
+
+    def dev_batch(rng):
+        return DeltaBatch(
+            rng.integers(0, 64, rows_per_tick).astype(np.int64),
+            rng.integers(0, 8, rows_per_tick).astype(np.float32),
+            np.ones(rows_per_tick, np.int64))
+
+    tmp = tempfile.mkdtemp(prefix="reflow_wal_bench_tpu_")
+    try:
+        wal_dir = os.path.join(tmp, "tick")
+        g, src, _red = build_dev()
+        sched = DurableScheduler(g, get_executor("tpu"), wal_dir=wal_dir,
+                                 fsync="tick")
+        rng = np.random.default_rng(23)
+        t0 = time.perf_counter()
+        for t in range(tpu_backlog):
+            sched.push(src, dev_batch(rng), batch_id=f"d{t}")
+            sched.tick(sync=False)
+        tpu_ingest_s = time.perf_counter() - t0
+        # abandon mid-flight (the simulated kill also tore a record)
+        tear_wal_tail(wal_dir, 7)
+        g2, src2, _red2 = build_dev()
+        fresh = DirtyScheduler(g2, get_executor("tpu"))
+        t0 = time.perf_counter()
+        report = recover(fresh, wal_dir)
+        tpu_recover_s = time.perf_counter() - t0
+        fresh.push(src2, dev_batch(np.random.default_rng(99)),
+                   batch_id="post-crash")
+        t1 = time.perf_counter()
+        fresh.tick()
+        tpu_first_tick_s = time.perf_counter() - t1
+        out.update({
+            "tpu_backlog_ticks": tpu_backlog,
+            "tpu_ingest_s": round(tpu_ingest_s, 3),
+            "tpu_recover_s": round(tpu_recover_s, 3),
+            "tpu_replayed_ticks": report.replayed_ticks,
+            "tpu_recovered_ticks_per_s": round(
+                report.replayed_ticks / max(tpu_recover_s, 1e-9)),
+            "tpu_first_tick_s": round(tpu_first_tick_s, 4),
+            "tpu_time_to_first_tick_s": round(
+                tpu_recover_s + tpu_first_tick_s, 3),
+        })
+        log(f"recovery[tpu]: replay {report.replayed_ticks} ticks in "
+            f"{tpu_recover_s:.3f}s, first tick {tpu_first_tick_s:.4f}s")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+# -- compiled mega-tick mode (REFLOW_BENCH_MEGATICK=1) ---------------------
+
+def run_megatick_bench() -> dict:
+    """Compiled mega-tick numbers (docs/guide.md "Compiled mega-ticks").
+
+    The PageRank churn-window protocol with the whole K-tick commit
+    window fused into ONE jit'd dispatch: ``tick_many`` routes through
+    ``TpuExecutor.run_window``, whose scan body consumes slots of the
+    device-resident ingress queue. The reported pair is the acceptance
+    metric: ``tick_s_amortized`` — full window wall including the
+    closing readback barrier, divided by K — vs ``window_dispatch_s`` —
+    the host-side cost of dispatching the entire window (queue slot
+    writes + one program enqueue). Dispatch-bound means the ratio stays
+    small: the host pays per-WINDOW cost, not per-tick cost.
+
+    Parity is asserted in-record: a twin scheduler is driven per-tick
+    (push + tick(sync=False)) with the IDENTICAL pre-generated churn
+    batches, and both drained rank tables must agree."""
+    from bench_configs import _median_window, _pad_batch, _settle, _sync_read
+    from reflow_tpu.executors import get_executor
+    from reflow_tpu.scheduler import DirtyScheduler
+    from reflow_tpu.workloads import pagerank
+
+    p = _params()
+    k = p["stream_ticks"]
+    n_windows = 3
+    n_churn = 2 * max(1, int(p["churn"] * p["n_edges"]))
+
+    pr, web = _build_pagerank(p["n_nodes"], p["n_edges"], p["churn"],
+                              p["tol"])
+    # pre-generate EVERYTHING before building the twin: WebGraph.churn
+    # mutates its edge set, so the batches are minted once and both
+    # drives consume the same list (and the same initial batch). Padding
+    # to a fixed row count keeps every window on ONE queue/program
+    # signature (weight-0 rows are semantic no-ops).
+    init = web.initial_batch()
+    churn = [_pad_batch(web.churn(p["churn"]), n_churn)
+             for _ in range((1 + n_windows) * k)]   # 1 warm + measured
+
+    sched = DirtyScheduler(pr.graph, get_executor("tpu"))
+    sched.push(pr.teleport, pagerank.teleport_batch(p["n_nodes"]))
+    sched.push(pr.edges, init)
+    sched.tick(sync=False)                       # cold build (compile)
+    warm = sched.tick_many([{pr.edges: b} for b in churn[:k]])
+    _settle(0 if p["smoke"] else 10, log, "drain build + warm window")
+
+    win_ix = [0]
+
+    def run_window_once():
+        lo = (1 + win_ix[0]) * k
+        feeds = [{pr.edges: b} for b in churn[lo:lo + k]]
+        win_ix[0] += 1
+        t0 = time.perf_counter()
+        res = sched.tick_many(feeds)
+        dwall = time.perf_counter() - t0    # host released: window queued
+        _sync_read(sched.executor)
+        wall = time.perf_counter() - t0
+        res.block()
+        assert res.quiesced
+        return wall, dwall, res.delta_ops
+
+    wall, dwall, dops, windows = _median_window(
+        run_window_once, log, f"megatick churn x{k}", n=n_windows)
+    warm.block()
+    assert sched.megatick_fallbacks == 0, (
+        f"window path fell back {sched.megatick_fallbacks}x — the bench "
+        f"must measure the fused path")
+    assert sched.megatick_windows == 1 + n_windows, sched.megatick_windows
+
+    # twin drive: identical batches through the per-tick streaming crank.
+    # It runs after the fused windows (on a tunnel device it lands in the
+    # degraded post-readback mode), so its wall is a reference point, not
+    # a head-to-head — table parity is the assertion here.
+    pr2, _ = _build_pagerank(p["n_nodes"], p["n_edges"], p["churn"],
+                             p["tol"])
+    per = DirtyScheduler(pr2.graph, get_executor("tpu"))
+    per.push(pr2.teleport, pagerank.teleport_batch(p["n_nodes"]))
+    per.push(pr2.edges, init)
+    per.tick(sync=False)
+    t0 = time.perf_counter()
+    results = []
+    for b in churn:
+        per.push(pr2.edges, b)
+        results.append(per.tick(sync=False))
+    _sync_read(per.executor)
+    pertick_wall_s = time.perf_counter() - t0
+    for r in results:
+        r.block()
+
+    ranks_m = pagerank.ranks_to_array(sched.read_table(pr.new_rank),
+                                      p["n_nodes"])
+    ranks_p = pagerank.ranks_to_array(per.read_table(pr2.new_rank),
+                                      p["n_nodes"])
+    max_abs_diff = float(np.abs(ranks_m - ranks_p).max())
+    out = {
+        "executor": "tpu", "nodes": p["n_nodes"], "edges": p["n_edges"],
+        "window_ticks": k,
+        "window_wall_s": round(wall, 4),
+        "window_dispatch_s": round(dwall, 4),
+        "tick_s_amortized": round(wall / k, 5),
+        "amortized_over_dispatch_x": round(
+            (wall / k) / max(dwall, 1e-9), 3),
+        "delta_ops_per_s": round(dops / wall),
+        "pertick_wall_s": round(pertick_wall_s, 4),
+        "megatick_windows": sched.megatick_windows,
+        "megatick_fallbacks": sched.megatick_fallbacks,
+        "window_dispatches": getattr(sched.executor,
+                                     "window_dispatches", 0),
+        "views_match": bool(max_abs_diff <= 1e-6),
+        "max_abs_diff": max_abs_diff,
+        "windows": [{"wall_s": round(w, 4), "dispatch_s": round(d, 4),
+                     "delta_ops": o} for w, d, o in windows],
+    }
+    log("megatick:", json.dumps(out))
     return out
 
 
@@ -1535,13 +1731,26 @@ def main() -> None:
         return
 
     if os.environ.get("REFLOW_BENCH_RECOVERY") == "1":
-        # WAL mode is host-side CPU work — no tunnel, no subprocesses
+        # WAL mode is mostly host-side work; the device-path section runs
+        # on whatever backend JAX_PLATFORMS selects (default cpu)
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         out = run_recovery_bench()
         _emit({
             "metric": "wal_recovery_time_to_first_tick_s",
             "value": out["time_to_first_tick_s"],
             "unit": "s",
+            **out,
+        }, json_out)
+        return
+
+    if os.environ.get("REFLOW_BENCH_MEGATICK") == "1":
+        # mega-tick mode measures the device window path — do NOT force
+        # cpu here; the tier-1 smoke sets JAX_PLATFORMS=cpu explicitly
+        out = run_megatick_bench()
+        _emit({
+            "metric": "megatick_amortized_tick_over_window_dispatch_x",
+            "value": out["amortized_over_dispatch_x"],
+            "unit": "x",
             **out,
         }, json_out)
         return

@@ -24,14 +24,86 @@ compaction) sets the join state's sticky ``error`` flag, raised at the
 next sync point. Sharded executors reach this through the same path:
 ``join_core`` runs per shard under ``shard_map`` (rows never migrate;
 each shard compacts its slice and its slot of ``rcount``).
+
+``propagate_plan_caps`` is the host-side static counterpart: the
+pre-dispatch capacity walk that rejects statically impossible ingress
+sizes and sizes the mega-tick ingress queue against the arenas.
 """
 
 from __future__ import annotations
 
+from typing import Dict
+
 import jax
 import jax.numpy as jnp
 
-__all__ = ["compact_arena"]
+from reflow_tpu.graph import GraphError
+
+__all__ = ["compact_arena", "propagate_plan_caps"]
+
+
+def propagate_plan_caps(plan, ingress_caps: Dict[int, int],
+                        divisor: int = 1) -> Dict[int, int]:
+    """Static per-tick capacity propagation against the Join arenas.
+
+    Walks ``plan`` in topo order carrying worst-case per-node egress row
+    counts from the seeded ``ingress_caps`` (sources, loops, fixpoint
+    boundary producers), and raises :class:`GraphError` for the
+    statically impossible case: one tick's delta capacity exceeding the
+    whole (per-shard, via ``divisor``) arena. The *dynamic* high-water
+    check stays inside the compiled program (``lax.cond`` compaction +
+    sticky error flag) — nothing here reads a device value back.
+
+    This is both the per-tick executor's pre-dispatch sanity check and
+    the mega-tick ingress queue's capacity negotiation: queue slots are
+    only allocated for capacities this propagation accepts.
+    """
+    outs_cap: Dict[int, int] = dict(ingress_caps)
+    for node in plan:
+        if node.kind in ("source", "loop") or node.id in ingress_caps:
+            continue
+        if node.kind == "sink":
+            continue
+        caps = [outs_cap.get(i.id, 0) for i in node.inputs]
+        if all(c == 0 for c in caps):
+            continue
+        if node.op.kind == "join":
+            cap = node.op.arena_capacity // divisor
+            if caps[1] > cap:
+                raise GraphError(
+                    f"{node}: a single tick's right-delta capacity "
+                    f"({caps[1]} rows) exceeds the per-shard arena "
+                    f"capacity {cap}; raise arena_capacity")
+            if not node.inputs[0].spec.unique:
+                La = ((node.op.left_arena_capacity
+                       or node.op.arena_capacity) // divisor)
+                if caps[0] > La:
+                    raise GraphError(
+                        f"{node}: a single tick's left-delta capacity "
+                        f"({caps[0]} rows) exceeds the per-shard left "
+                        f"arena capacity {La}; raise "
+                        f"left_arena_capacity")
+                # both products are budget-bounded pair enumerations
+                outs_cap[node.id] = (node.op.product_slack
+                                     * (caps[0] + caps[1]) * divisor)
+                continue
+            # an absent left delta skips the arena sweep entirely;
+            # sharded: each of the n shards emits 2*R/n + caps[1] rows
+            # (the right delta is all_gather'd), so global egress is
+            # 2*R + n*caps[1]
+            outs_cap[node.id] = (
+                (2 * node.op.arena_capacity if caps[0] else 0) +
+                divisor * caps[1])
+        elif node.op.kind == "reduce":
+            K = node.inputs[0].spec.key_space
+            outs_cap[node.id] = 2 * K if caps[0] >= K else 2 * caps[0]
+        elif node.op.kind == "knn":
+            outs_cap[node.id] = 2 * node.inputs[0].spec.key_space
+        elif node.op.kind == "union":
+            outs_cap[node.id] = sum(caps)
+        else:
+            outs_cap[node.id] = caps[0]
+    return outs_cap
 
 
 def compact_arena(state: dict) -> dict:

@@ -1,0 +1,134 @@
+"""Device-resident ingress queue for compiled mega-ticks.
+
+One K-tick commit window is one device execution
+(``TpuExecutor.run_window``): the scan body consumes one queue *slot*
+— a ``(tick, source)`` cell of a preallocated, statically-shaped delta
+buffer — per tick per source. The queue replaces the host-side
+``_stack_feeds`` restack (allocate + copy + upload [K, C] arrays every
+window) with index-updates into persistent device buffers:
+
+- buffers are allocated ONCE per (plan, capacity, K) signature and
+  reused window after window (they live in the executor's program
+  cache, invalidated with it on rebind);
+- each host micro-batch is padded to its source's capacity bucket and
+  written into its slot with a jitted ``.at[t].set`` (the slot index is
+  a traced scalar, so writes never recompile);
+- an empty slot (window padding — a tick where this source had no
+  deltas) is overwritten from a cached device-resident zero image: no
+  host transfer at all, and no stale rows from the previous window can
+  leak (every slot is written every window);
+- capacity is negotiated with the arena up front: the caller validates
+  the per-source caps through the same static propagation the per-tick
+  path uses (``arena.propagate_plan_caps``) BEFORE any device memory is
+  reserved.
+
+The buffers are deliberately NOT donated to the window program (only
+the state pytree is), so they survive the dispatch and the next window
+writes in place. Donating them (saving one aliasing copy per window) is
+a known follow-up.
+
+``slot_nbytes`` is the admission-side view of the same reservation: the
+device bytes one host batch will occupy in its queue slot, used by the
+serve frontend to key the ``AdmissionBudget`` on device memory pressure
+instead of host payload bytes.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+import jax
+
+from reflow_tpu.executors.device_delta import (DeviceDelta, bucket_capacity,
+                                               check_weight_mass)
+
+__all__ = ["DeviceIngressQueue", "slot_nbytes"]
+
+
+def slot_nbytes(spec, rows: int) -> int:
+    """Device bytes a host batch of ``rows`` reserves in its queue slot:
+    the capacity bucket times the per-row footprint (int32 key + int32
+    weight + the value payload). This is what admission should charge
+    when backpressure tracks device memory, not host payload size."""
+    cap = bucket_capacity(int(rows))
+    per_val = int(np.prod(spec.value_shape)) if spec.value_shape else 1
+    return cap * (4 + 4 + per_val * np.dtype(spec.value_dtype).itemsize)
+
+
+def _write_slot(bufs: DeviceDelta, t, keys, values, weights) -> DeviceDelta:
+    # t is traced (dynamic_update_slice), so one compilation covers every
+    # slot of a buffer shape; donated bufs make the update in place
+    return DeviceDelta(bufs.keys.at[t].set(keys),
+                       bufs.values.at[t].set(values),
+                       bufs.weights.at[t].set(weights))
+
+
+class DeviceIngressQueue:
+    """Per-source [K, cap] delta buffers plus their jitted slot writer.
+
+    ``specs``/``caps`` map source node ids to their Spec and padded
+    per-tick row capacity; ``k`` is the window length in ticks.
+    """
+
+    def __init__(self, specs: Dict[int, object], caps: Dict[int, int],
+                 k: int):
+        import jax.numpy as jnp
+
+        self.k = int(k)
+        self.caps = dict(caps)
+        self._specs = dict(specs)
+        self._bufs: Dict[int, DeviceDelta] = {}
+        self._zero: Dict[int, tuple] = {}
+        self.writes = 0
+        self.zero_writes = 0
+        self.nbytes = 0
+        for nid, cap in sorted(caps.items()):
+            spec = specs[nid]
+            vshape = tuple(spec.value_shape)
+            self._bufs[nid] = DeviceDelta(
+                jnp.zeros((k, cap), jnp.int32),
+                jnp.zeros((k, cap) + vshape, spec.value_dtype),
+                jnp.zeros((k, cap), jnp.int32))
+            # the padding image: device-resident so an empty slot's write
+            # is a pure on-device index-update (zero host bytes moved)
+            self._zero[nid] = (jnp.zeros((cap,), jnp.int32),
+                               jnp.zeros((cap,) + vshape, spec.value_dtype),
+                               jnp.zeros((cap,), jnp.int32))
+            self.nbytes += k * slot_nbytes(spec, cap)
+        self._writer = jax.jit(_write_slot, donate_argnums=0)
+
+    def write(self, t: int, nid: int, batch) -> None:
+        """Fill slot ``(t, nid)`` from a host batch (zero-row batches
+        write the cached zero image). Every slot must be written every
+        window — the buffers persist, so a skipped slot would replay the
+        previous window's rows."""
+        cap = self.caps[nid]
+        n = len(batch)
+        if n > cap:
+            raise ValueError(
+                f"batch of {n} rows exceeds queue slot capacity {cap} "
+                f"for node {nid}")
+        if n == 0:
+            keys, values, weights = self._zero[nid]
+            self.zero_writes += 1
+        else:
+            check_weight_mass(batch)   # same host-boundary guard as upload
+            spec = self._specs[nid]
+            vshape = tuple(spec.value_shape)
+            keys = np.zeros(cap, np.int32)
+            keys[:n] = batch.keys.astype(np.int64)
+            weights = np.zeros(cap, np.int32)
+            weights[:n] = batch.weights
+            values = np.zeros((cap,) + vshape, spec.value_dtype)
+            values[:n] = np.asarray(batch.values).reshape((n,) + vshape)
+        self._bufs[nid] = self._writer(self._bufs[nid], t, keys, values,
+                                       weights)
+        self.writes += 1
+
+    def stacked(self) -> Dict[int, DeviceDelta]:
+        """The queue's current contents as the [K, cap] ingress stack the
+        window program scans — same pytree shape ``_stack_feeds``
+        produces, so the compiled programs are shared between paths."""
+        return dict(self._bufs)

@@ -16,6 +16,7 @@ signature, so steady-state ticks hit the cache and pay zero tracing cost.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -61,6 +62,13 @@ class TpuExecutor(Executor):
         #: per-program copy would duplicate tens of MB of HBM per ingress
         #: bucket and re-sort appends the other signature already covered)
         self._csr_cache: Dict[int, dict] = {}
+        #: mega-tick window path (run_window): per-source host batches
+        #: above this row bound don't fit a reasonable queue slot — the
+        #: scheduler falls back to the per-tick path instead
+        self.megatick_max_rows = int(os.environ.get(
+            "REFLOW_MEGATICK_MAX_ROWS", str(1 << 16)))
+        #: windows dispatched through the device-resident ingress queue
+        self.window_dispatches = 0
 
     # -- bind: validate lowerability, build device state -------------------
 
@@ -316,28 +324,122 @@ class TpuExecutor(Executor):
         overhead (~0.1-0.3s measured, independent of program size);
         ``lax.scan``-ing K ticks into one execution amortizes it K-fold.
         """
-        from reflow_tpu.executors.fixpoint import analyze
-
-        # fixpoint=False is the whole-tick-fusion opt-out (and what the
-        # staged executor, whose states are pinned per stage device,
-        # relies on to keep tick_many on the per-tick fallback)
-        if not self.fixpoint or self.graph.sinks:
+        if not self.supports_window():
             return None
         K = len(feeds)
         node_ids = sorted(feeds[0])
         if any(sorted(f) != node_ids for f in feeds):
             return None
 
+        t_h0 = time.perf_counter() if _trace.ENABLED else 0.0
+        stack, caps = self._stack_feeds(feeds)
+        if _trace.ENABLED:
+            _trace.evt("stack_feeds", t_h0, time.perf_counter() - t_h0,
+                       args={"ticks": K})
+        return self._dispatch_many(plan, stack, caps, K, max_iters)
+
+    def supports_window(self) -> bool:
+        """Does this executor's bound graph fit the fused macro-tick /
+        mega-tick window path? The scheduler's ``window_support``
+        property and the serve frontend read this to decide whether the
+        window path can engage at all. ``fixpoint=False`` is the
+        whole-tick-fusion opt-out (and what the staged executor, whose
+        states are pinned per stage device, relies on to keep tick_many
+        on the per-tick fallback); sinks need per-tick host egress."""
+        from reflow_tpu.executors.fixpoint import analyze
+
+        if self.graph is None or not self.fixpoint or self.graph.sinks:
+            return False
+        if not self.graph.loops:
+            return True
+        if self._fx_unsupported:
+            return False
+        if self._fx_structure is None:
+            self._fx_structure = analyze(self.graph)
+            if self._fx_structure is None:
+                self._fx_unsupported = True
+                return False
+        return True
+
+    def run_window(self, plan, feeds, max_iters):
+        """One K-tick commit window as ONE dispatch fed from the
+        device-resident ingress queue (the compiled mega-tick).
+
+        Same contract as :meth:`run_tick_fixpoint_many` — ``feeds`` is a
+        list of K ``{node_id: DeltaBatch}`` dicts over an identical
+        (scheduler-padded) source set — but instead of restacking host
+        [K, C] arrays every window, each batch is index-written into a
+        persistent per-(plan, caps, K) queue slot and the window program
+        scans the queue buffers in place. Returns the
+        ``(passes_base, iters, rows, converged, extra_dirty)`` tuple
+        with per-tick counters device-resident, or None when the window
+        doesn't fit (device-resident batches, rows above
+        ``megatick_max_rows``, unsupported graph) — the scheduler then
+        falls back to the stacked/per-tick paths.
+        """
+        if not self.supports_window():
+            return None
+        K = len(feeds)
+        node_ids = sorted(feeds[0])
+        if any(sorted(f) != node_ids for f in feeds):
+            return None
+        caps: Dict[int, int] = {}
+        for nid in node_ids:
+            rows = 0
+            for f in feeds:
+                b = f[nid]
+                if hasattr(b, "nonzero"):
+                    # already device-resident: no host rows to slot-write
+                    # (and len() would force a readback) — stack path
+                    return None
+                rows = max(rows, len(b))
+            if rows > self.megatick_max_rows:
+                return None
+            caps[nid] = bucket_capacity(rows)
+
+        qsig = ("ingress_q", tuple(n.id for n in plan),
+                tuple(sorted(caps.items())), K)
+        queue = self._cache.get(qsig)
+        if queue is None:
+            from reflow_tpu.executors.ingress_queue import DeviceIngressQueue
+
+            # negotiate capacity with the arena BEFORE reserving device
+            # memory: impossible ingress sizes raise here, not mid-window
+            self._track_arena(plan, caps)
+            queue = DeviceIngressQueue(
+                {nid: self.graph.nodes[nid].spec for nid in node_ids},
+                caps, K)
+            self._cache[qsig] = queue
+
+        t_h0 = time.perf_counter() if _trace.ENABLED else 0.0
+        for t, f in enumerate(feeds):
+            for nid in node_ids:
+                queue.write(t, nid, f[nid])
+        if _trace.ENABLED:
+            _trace.evt("queue_write", t_h0, time.perf_counter() - t_h0,
+                       args={"ticks": K, "slots": K * len(node_ids)})
+        out = self._dispatch_many(plan, queue.stacked(), caps, K,
+                                  max_iters, window=True)
+        if out is not None:
+            self.window_dispatches += 1
+        return out
+
+    def _dispatch_many(self, plan, stack, caps, K, max_iters, *,
+                       window: bool = False):
+        """Shared macro-tick dispatch tail: compile (or reuse) the K-tick
+        scan program for ``plan``/``caps``, run it over the [K, C]
+        ingress ``stack``, and return the scheduler-facing
+        ``(passes_base, iters, rows, converged, extra_dirty)`` tuple
+        (None when the fixpoint program lacks a fused ``call_many``).
+        ``window=True`` tags the dispatch span as the mega-tick path and
+        wraps it in a ``jax.profiler`` annotation so Perfetto lines host
+        stages up against device occupancy."""
+        from reflow_tpu.utils.metrics import profile_annotation
+
         if not self.graph.loops:
             # loop-free sink-free graph (e.g. streaming TF-IDF): scan the
             # PLAIN pass program over the K stacked feeds — one device
             # execution for K ticks, zero per-tick egress by construction
-            t_h0 = time.perf_counter() if _trace.ENABLED else 0.0
-            stack, caps = self._stack_feeds(feeds)
-            if _trace.ENABLED:
-                _trace.evt("stack_feeds", t_h0,
-                           time.perf_counter() - t_h0,
-                           args={"ticks": K})
             sig = ("pass_many", tuple(n.id for n in plan),
                    tuple(sorted(caps.items())))
             prog = self._cache.get(sig)
@@ -357,27 +459,16 @@ class TpuExecutor(Executor):
                 prog = jax.jit(scan_fn, donate_argnums=0)
                 self._cache[sig] = prog
             self._track_arena(plan, caps)
+            kind = "window" if window else "pass_many"
             t_d0 = time.perf_counter() if _trace.ENABLED else 0.0
-            self.states = prog(dict(self.states), stack)
+            with profile_annotation(f"reflow.window[{K}]", enabled=window):
+                self.states = prog(dict(self.states), stack)
             if _trace.ENABLED:
                 _trace.evt("device_dispatch", t_d0,
                            time.perf_counter() - t_d0,
-                           args={"kind": "pass_many", "ticks": K})
+                           args={"kind": kind, "ticks": K})
             return K, 0, 0, True, set()
 
-        if self._fx_unsupported:
-            return None
-        if self._fx_structure is None:
-            self._fx_structure = analyze(self.graph)
-            if self._fx_structure is None:
-                self._fx_unsupported = True
-                return None
-
-        t_h0 = time.perf_counter() if _trace.ENABLED else 0.0
-        stack, caps = self._stack_feeds(feeds)
-        if _trace.ENABLED:
-            _trace.evt("stack_feeds", t_h0, time.perf_counter() - t_h0,
-                       args={"ticks": K})
         sig = ("fx", tuple(n.id for n in plan),
                tuple(sorted(caps.items())), max_iters)
         prog = self._cache.get(sig)
@@ -396,13 +487,15 @@ class TpuExecutor(Executor):
                 list(st.exit_plan),
                 {n.id: 2 * n.inputs[0].spec.key_space for n in st.boundary})
 
+        kind = "window" if window else "fixpoint_many"
         t_d0 = time.perf_counter() if _trace.ENABLED else 0.0
-        new_states, (iters, rows, conv) = prog.call_many(
-            dict(self.states), stack, K)
+        with profile_annotation(f"reflow.window[{K}]", enabled=window):
+            new_states, (iters, rows, conv) = prog.call_many(
+                dict(self.states), stack, K)
         if _trace.ENABLED:
             _trace.evt("device_dispatch", t_d0,
                        time.perf_counter() - t_d0,
-                       args={"kind": "fixpoint_many", "ticks": K})
+                       args={"kind": kind, "ticks": K})
         self.states = new_states
         extra_dirty = set(st.region_ids) | {n.id for n in st.exit_plan}
         passes_base = K * (1 + (1 if st.exit_plan else 0))
@@ -608,55 +701,13 @@ class TpuExecutor(Executor):
         This host check only rejects the statically impossible case: one
         tick's right-delta capacity exceeding the whole (per-shard) arena.
         ``ingress_caps`` maps seeded node ids (sources, loops, fixpoint
-        boundary producers) to their delta capacities.
+        boundary producers) to their delta capacities. The propagation
+        itself lives in :func:`arena.propagate_plan_caps` so the
+        mega-tick ingress queue negotiates against the same rules.
         """
-        outs_cap: Dict[int, int] = dict(ingress_caps)
-        for node in plan:
-            if node.kind in ("source", "loop") or node.id in ingress_caps:
-                continue
-            if node.kind == "sink":
-                continue
-            caps = [outs_cap.get(i.id, 0) for i in node.inputs]
-            if all(c == 0 for c in caps):
-                continue
-            if node.op.kind == "join":
-                cap = node.op.arena_capacity // self._arena_divisor
-                if caps[1] > cap:
-                    raise GraphError(
-                        f"{node}: a single tick's right-delta capacity "
-                        f"({caps[1]} rows) exceeds the per-shard arena "
-                        f"capacity {cap}; raise arena_capacity")
-                if not node.inputs[0].spec.unique:
-                    La = ((node.op.left_arena_capacity
-                           or node.op.arena_capacity)
-                          // self._arena_divisor)
-                    if caps[0] > La:
-                        raise GraphError(
-                            f"{node}: a single tick's left-delta capacity "
-                            f"({caps[0]} rows) exceeds the per-shard left "
-                            f"arena capacity {La}; raise "
-                            f"left_arena_capacity")
-                    # both products are budget-bounded pair enumerations
-                    outs_cap[node.id] = (node.op.product_slack
-                                         * (caps[0] + caps[1])
-                                         * self._arena_divisor)
-                    continue
-                # an absent left delta skips the arena sweep entirely;
-                # sharded: each of the n shards emits 2*R/n + caps[1] rows
-                # (the right delta is all_gather'd), so global egress is
-                # 2*R + n*caps[1]
-                outs_cap[node.id] = (
-                    (2 * node.op.arena_capacity if caps[0] else 0) +
-                    self._arena_divisor * caps[1])
-            elif node.op.kind == "reduce":
-                K = node.inputs[0].spec.key_space
-                outs_cap[node.id] = 2 * K if caps[0] >= K else 2 * caps[0]
-            elif node.op.kind == "knn":
-                outs_cap[node.id] = 2 * node.inputs[0].spec.key_space
-            elif node.op.kind == "union":
-                outs_cap[node.id] = sum(caps)
-            else:
-                outs_cap[node.id] = caps[0]
+        from reflow_tpu.executors.arena import propagate_plan_caps
+
+        propagate_plan_caps(plan, ingress_caps, self._arena_divisor)
 
     # -- trace & compile one pass program ----------------------------------
 

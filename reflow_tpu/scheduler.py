@@ -18,6 +18,7 @@ in the executor.
 from __future__ import annotations
 
 import dataclasses
+import os
 import time
 from collections import Counter, defaultdict
 from typing import Callable, Dict, List, Optional, Sequence
@@ -205,6 +206,17 @@ class DirtyScheduler:
         #: dispatch, so the first increments also emits a one-time
         #: warning (utils/runtime.note_forced_sync) — VERDICT r3 weak #6
         self.forced_syncs = 0
+        #: mega-tick window path (docs/guide.md "Compiled mega-ticks"):
+        #: windows dispatched through the device ingress queue vs windows
+        #: that fell back (ragged feeds too wasteful, over-capacity
+        #: batches, device-resident feeds, unsupported graph)
+        self.megatick_windows = 0
+        self.megatick_fallbacks = 0
+        #: max tolerated padding waste: the fraction of the window's
+        #: (tick, source) slots that would be zero-row padding. Divergent
+        #: per-tick dirty sets above this run the per-tick path instead
+        self.megatick_waste = float(os.environ.get(
+            "REFLOW_MEGATICK_WASTE", "0.5"))
 
     # -- host boundary in --------------------------------------------------
 
@@ -440,10 +452,12 @@ class DirtyScheduler:
                         f"can only feed sources/loops, not {node}")
 
         t0 = time.perf_counter()
-        runner = getattr(self.executor, "run_tick_fixpoint_many", None)
         fx = None
         plan = self._dirty_plan(sorted({n for f in feeds for n in f}))
-        if runner is not None and feeds:
+        if feeds:
+            fx = self._run_window_path(plan, feeds)
+        runner = getattr(self.executor, "run_tick_fixpoint_many", None)
+        if fx is None and runner is not None and feeds:
             fx = runner(plan, feeds, self.max_loop_iters)
         if fx is None:
             # fallback: ordinary streaming ticks, aggregated lazily (no
@@ -501,6 +515,65 @@ class DirtyScheduler:
         self.history.append(result)
         return result
 
+    # -- mega-tick window path (docs/guide.md "Compiled mega-ticks") -------
+
+    @property
+    def window_support(self) -> bool:
+        """Whether the executor advertises the fused window path for the
+        bound graph (the serve frontend reads this to pick admission
+        accounting and the pump's default window behavior)."""
+        sup = getattr(self.executor, "supports_window", None)
+        return bool(sup()) if callable(sup) else False
+
+    def _zero_batch(self, nid: int) -> DeltaBatch:
+        spec = self.graph.nodes[nid].spec
+        vshape = tuple(spec.value_shape)
+        return DeltaBatch(np.zeros(0, np.int64),
+                          np.zeros((0,) + vshape, spec.value_dtype),
+                          np.zeros(0, np.int64))
+
+    def _run_window_path(self, plan, feeds):
+        """Try the device-resident window executor on this tick_many
+        call: pad ragged per-tick feeds to the window's union source set
+        with zero-row deltas (weight-0 rows are semantic no-ops, so the
+        compiled body keeps ONE fixed plan for the whole window) and
+        hand the window to ``executor.run_window``. Returns the fused
+        result tuple or None — padding waste above ``megatick_waste``,
+        over-capacity batches, and executor refusals fall back to the
+        stacked/per-tick paths, counted in ``megatick_fallbacks``.
+        Device-resident batches skip silently (they ride their own feed
+        slot by design — that's the walpipe protocol, not a fallback).
+        """
+        run = getattr(self.executor, "run_window", None)
+        if run is None or not self.window_support:
+            return None
+        for f in feeds:
+            for b in f.values():
+                if hasattr(b, "nonzero"):
+                    return None
+        K = len(feeds)
+        union = sorted({n for f in feeds for n in f})
+        if not union:
+            return None
+        pad_slots = sum(1 for f in feeds for nid in union
+                        if nid not in f or len(f[nid]) == 0)
+        if pad_slots / (K * len(union)) > self.megatick_waste:
+            # dirty sets diverge too much: padding every tick to the
+            # union would mostly move zeros — per-tick plans win
+            self.megatick_fallbacks += 1
+            return None
+        padded = [dict(f) for f in feeds]
+        for f in padded:
+            for nid in union:
+                if nid not in f:
+                    f[nid] = self._zero_batch(nid)
+        fx = run(plan, padded, self.max_loop_iters)
+        if fx is None:
+            self.megatick_fallbacks += 1
+        else:
+            self.megatick_windows += 1
+        return fx
+
     def publish_metrics(self, registry=None, *, name: Optional[str]
                         = None) -> str:
         """Register live scheduler gauges (tick horizon, forced syncs,
@@ -516,6 +589,9 @@ class DirtyScheduler:
         reg.gauge(f"{key}.pending_batches",
                   lambda: sum(len(v) for v in self._pending.values()))
         reg.gauge(f"{key}.history_len", lambda: len(self.history))
+        reg.gauge(f"{key}.megatick_windows", lambda: self.megatick_windows)
+        reg.gauge(f"{key}.megatick_fallbacks",
+                  lambda: self.megatick_fallbacks)
         return key
 
     def rederive(self, source: Node, batch: DeltaBatch):

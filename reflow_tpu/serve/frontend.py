@@ -127,13 +127,27 @@ class IngestFrontend:
                  queue_batches: int = 256, max_bytes: int = 64 << 20,
                  window: Optional[CoalesceWindow] = None, crash=None,
                  start: bool = True, budget=None, lock=None, work=None,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None, admission: str = "auto"):
         if policy not in POLICIES:
             raise ValueError(f"policy {policy!r} not in {POLICIES}")
+        if admission not in ("auto", "host", "device"):
+            raise ValueError(
+                f"admission {admission!r} not in ('auto', 'host', "
+                f"'device')")
         self.sched = sched
         self.policy = policy
         self.window = window if window is not None else CoalesceWindow()
         self.name = name
+        #: the executor advertises the fused mega-tick window path for
+        #: this graph: the pump's tick_many windows dispatch through the
+        #: device ingress queue (docs/guide.md "Compiled mega-ticks")
+        self.megatick = bool(getattr(sched, "window_support", False))
+        #: what a host batch's admission charge measures: "host" = its
+        #: payload bytes, "device" = the queue-slot bytes it will reserve
+        #: on device (backpressure then tracks device memory pressure);
+        #: "auto" picks "device" exactly when the window path engages
+        self.admission = ("device" if admission == "auto" and self.megatick
+                          else "host" if admission == "auto" else admission)
         self._crash = crash
         self._lock = lock if lock is not None else threading.Lock()
         self._not_full = threading.Condition(self._lock)   # producers
@@ -244,7 +258,7 @@ class IngestFrontend:
                                              reason="empty batch"))
                 self._trace_submit(ticket, "empty")
                 return ticket
-            nbytes = batch_nbytes(batch)
+            nbytes = self._charge_bytes(source, batch, device)
             if not self._admit(source, nbytes, ticket, batch_id, deadline):
                 return ticket  # ticket already resolved REJECTED/…
             if batch_id in self._admitted:
@@ -285,6 +299,21 @@ class IngestFrontend:
                        time.perf_counter() - ctx.t0,
                        args={"batch_id": ticket.batch_id,
                              "outcome": outcome})
+
+    def _charge_bytes(self, source: Node, batch, device: bool) -> int:
+        """What this batch's admission charges against the byte budget.
+        Under device-keyed admission (``admission="device"``, the
+        mega-tick default) a host batch is charged the device bytes its
+        ingress-queue slot will reserve — the capacity-bucketed padded
+        footprint — so backpressure reflects actual device memory
+        pressure, not host payload size. Device-resident batches always
+        charge their (device) payload bytes; both reads are metadata,
+        never a device sync."""
+        if not device and self.admission == "device":
+            from reflow_tpu.executors.ingress_queue import slot_nbytes
+
+            return slot_nbytes(source.spec, len(batch))
+        return batch_nbytes(batch)
 
     def _admit(self, source: Node, nbytes: int, ticket: Ticket,
                batch_id: str, deadline: Optional[float]) -> bool:
@@ -663,7 +692,8 @@ class IngestFrontend:
             if tr:
                 _trace.evt("pump_execute", t_exec0, t_exec1 - t_exec0,
                            args={"graph": self.name or "frontend",
-                                 "ticks": len(chunk), "lsn": lsn})
+                                 "ticks": len(chunk), "lsn": lsn,
+                                 "megatick": self.megatick})
             self._crash_point("pump_after_tick")
             items = []
             for j, f in enumerate(chunk):

@@ -70,6 +70,9 @@ class GraphConfig:
     guaranteed / maximum slice of the tier's byte budget. ``policy`` /
     ``queue_batches`` / ``window`` are the frontend's backpressure
     policy, per-source depth bound, and coalescing window.
+    ``admission`` keys the byte charge: ``"host"`` = payload bytes,
+    ``"device"`` = ingress-queue slot bytes, ``"auto"`` = device iff
+    the graph's executor advertises the mega-tick window path.
     """
 
     weight: float = 1.0
@@ -79,6 +82,7 @@ class GraphConfig:
     queue_batches: int = 256
     window: Optional[CoalesceWindow] = None
     crash: Optional[object] = None  # CrashInjector override (tests)
+    admission: str = "auto"
 
 
 def dwrr_pick(ready: List["GraphHandle"],
@@ -216,7 +220,8 @@ class ServeTier:
                     crash=cfg.crash if cfg.crash is not None
                     else self._crash,
                     start=False, budget=share, lock=self._lock,
-                    work=self._work, name=name)
+                    work=self._work, name=name,
+                    admission=cfg.admission)
             except BaseException:
                 self.budget.unregister(name)
                 raise

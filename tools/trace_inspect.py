@@ -110,6 +110,14 @@ def inspect(path: str) -> dict:
         name[len("control."):]: len(durs)
         for name, durs in sorted(by_name.items())
         if name.startswith("control.")}
+    # mega-tick occupancy: how much of the commit-window wall was the
+    # device dispatch itself — the compiled-window path drives this
+    # toward 1.0 (dispatch-bound), the per-tick crank leaves it low
+    dispatch_us = sum(by_name.get("device_dispatch", ()))
+    window_us = (sum(by_name.get("window", ()))
+                 or sum(by_name.get("tick_many", ())))
+    window_dispatch_frac = (round(dispatch_us / window_us, 4)
+                            if window_us else 0.0)
     fsync_total = sum(fsync_on) + sum(fsync_off)
     durability = {
         "onpath_fsyncs": len(fsync_on),
@@ -127,6 +135,7 @@ def inspect(path: str) -> dict:
         "events": sum(len(d) for d in by_name.values()),
         "tracks": len(tracks),
         "durability": durability,
+        "window_dispatch_frac": window_dispatch_frac,
         "control_actions": control_actions,
         "spans": spans,
         "tickets": len(tickets),
@@ -152,6 +161,10 @@ def _print_human(s: dict) -> None:
               f"dispatch path ({dur['offpath_fsync_frac']:.0%} of fsync "
               f"time), {dur['onpath_fsyncs']} inline; mean group "
               f"coverage {dur['fsync_covered_mean']:.1f}")
+    if s["window_dispatch_frac"]:
+        print(f"window dispatch fraction: "
+              f"{s['window_dispatch_frac']:.0%} of commit-window time "
+              f"was device dispatch")
     if s["control_actions"]:
         acts = ", ".join(f"{k}={v}"
                          for k, v in s["control_actions"].items())
