@@ -77,6 +77,17 @@ Env knobs::
                                   recover) and hot/quiet-tenant QoS
                                   isolation (CPU-only, no tunnel)
     REFLOW_BENCH_TIER_BATCHES     micro-batches per producer (default 200)
+    REFLOW_BENCH_SHARDSERVE=1     pod-scale serving mode instead: the
+                                  same mega-tick tier load three ways —
+                                  8 tenants on one device, 8 tenants
+                                  spread one-per-device (placement=
+                                  "spread", shared window programs via
+                                  the plan-signature cache), and ONE
+                                  sharded hot tenant spanning the mesh —
+                                  with exact view parity vs a CPU oracle
+                                  and zero fallbacks (cpu runs force 8
+                                  host devices; real meshes use theirs)
+    REFLOW_BENCH_SHARDSERVE_BATCHES  batches per producer (default 48)
     REFLOW_BENCH_CONTROL=1        control mode instead: self-healing
                                   ControlPlane under step load — a
                                   hot-tenant surge browned out per-graph
@@ -1152,6 +1163,226 @@ def run_tier_bench() -> dict:
     return out
 
 
+# -- pod-scale serving mode (REFLOW_BENCH_SHARDSERVE=1) --------------------
+
+def run_shardserve_bench() -> dict:
+    """Pod-scale serving numbers (docs/guide.md "Sharded serving").
+
+    Three tiers over the same loop-free aggregation workload (source ->
+    vectorized map -> reduce(sum), integer-valued f32 values so every
+    view comparison is EXACT — elementwise math is sharding-invariant
+    bit-for-bit, and integer-valued sums below 2^24 make the cross-row
+    reduction order irrelevant), all committing through the fused
+    mega-tick window path:
+
+    A. **single-device baseline** — 8 tenants on one ``ServeTier``,
+       every executor on the default device (windows serialize on one
+       chip — the PR-7 state of the world);
+    B. **spread placement** — the same 8 tenants with
+       ``GraphConfig(placement="spread")``: one executor per mesh
+       device, windows dispatch concurrently, and the structurally-
+       identical tenants adopt ONE traced window program from the
+       plan-signature cache (``megatick_cache_hits``);
+    C. **sharded hot tenant** — the same total load on ONE graph whose
+       ``ShardedTpuExecutor`` spans the mesh: queue buffers NamedSharded
+       along the capacity axis, the window scan running under shard_map.
+
+    Every tier's reduce tables are compared exactly (max_abs_diff must
+    be 0.0) against a CPU per-tick oracle fed the identical batches, and
+    the fallback counters must be 0 — the happy path has to BE the
+    fused spread/sharded path, not a silent per-tick fallback.
+
+    CPU-CI note: under ``--xla_force_host_platform_device_count=8`` all
+    "devices" share the host cores (this container: one), so neither
+    spread nor sharded can beat the baseline WALL here — the
+    ``*_ge_baseline`` flags relax to ``ge_slack`` of baseline on cpu
+    (1.0 on a real mesh) and the raw rows/s + ratios are the artifact;
+    scaling headroom shows on real multi-chip hardware.
+    """
+    import threading
+
+    import jax
+
+    from reflow_tpu.delta import DeltaBatch, Spec
+    from reflow_tpu.executors import get_executor
+    from reflow_tpu.graph import FlowGraph
+    from reflow_tpu.scheduler import DirtyScheduler
+    from reflow_tpu.serve import CoalesceWindow, GraphConfig, ServeTier
+
+    smoke = os.environ.get("REFLOW_BENCH_SMOKE") == "1"
+    n_graphs = 8
+    key_space = 256
+    rows_per_batch = 64
+    per_producer = int(os.environ.get(
+        "REFLOW_BENCH_SHARDSERVE_BATCHES", "8" if smoke else "48"))
+    window = CoalesceWindow(max_rows=4096, max_ticks=4,
+                            max_latency_s=0.003)
+    n_devices = len(jax.devices())
+    platform = jax.default_backend()
+    ge_slack = 1.0 if platform == "tpu" else 0.25
+    total_rows = n_graphs * per_producer * rows_per_batch
+
+    def build():
+        g = FlowGraph("shardserve")
+        spec = Spec((), np.float32, key_space=key_space)
+        src = g.source("events", spec)
+        m = g.map(src, lambda v: v * np.float32(3) + np.float32(1),
+                  vectorized=True)
+        r = g.reduce(m, "sum", tol=0.0)
+        return g, src, r
+
+    def make_batch(gi: int, j: int) -> DeltaBatch:
+        rng = np.random.default_rng(gi * 7919 + j + 1)
+        keys = rng.integers(0, key_space, rows_per_batch).astype(np.int64)
+        vals = rng.integers(0, 8, rows_per_batch).astype(np.float32)
+        return DeltaBatch(keys, vals,
+                          np.ones(rows_per_batch, np.int64))
+
+    def table(sched, r):
+        return {int(k): float(np.asarray(v).reshape(()))
+                for k, v in sched.read_table(r).items()}
+
+    def oracle(graph_ids):
+        g, src, r = build()
+        sched = DirtyScheduler(g, get_executor("cpu"))
+        for gi in graph_ids:
+            for j in range(per_producer):
+                sched.push(src, make_batch(gi, j))
+                sched.tick()
+        return table(sched, r)
+
+    def max_diff(got, want):
+        ks = set(got) | set(want)
+        return max((abs(got.get(k, 0.0) - want.get(k, 0.0)) for k in ks),
+                   default=0.0)
+
+    def drive(targets):
+        # targets: (handle, src, gi) per producer thread; the wall covers
+        # submission through the last committed window (flush)
+        def produce(h, src, gi):
+            for j in range(per_producer):
+                h.submit(src, make_batch(gi, j))
+
+        threads = [threading.Thread(target=produce, args=t)
+                   for t in targets]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for h, _src, _gi in targets:
+            h.flush()
+        return time.perf_counter() - t0
+
+    def run_tier(placement):
+        tier = ServeTier(max_bytes=64 << 20,
+                         pump_threads=min(n_graphs, 8))
+        scheds, targets, reduces = [], [], []
+        for gi in range(n_graphs):
+            g, src, r = build()
+            sched = DirtyScheduler(g, get_executor("tpu"))
+            cfg = (GraphConfig(window=window, placement=placement)
+                   if placement else GraphConfig(window=window))
+            h = tier.register(f"g{gi}", sched, cfg)
+            scheds.append(sched)
+            reduces.append(r)
+            targets.append((h, src, gi))
+        wall = drive(targets)
+        tables = [table(s, r) for s, r in zip(scheds, reduces)]
+        stats = {
+            "windows": sum(s.megatick_windows for s in scheds),
+            "fallbacks": sum(s.megatick_fallbacks for s in scheds),
+            "cache_hits": sum(s.executor.megatick_cache_hits
+                              for s in scheds),
+            "devices": sorted({s.executor.device_label or "(default)"
+                               for s in scheds}),
+        }
+        tier.close()
+        return wall, tables, stats
+
+    want = [oracle([gi]) for gi in range(n_graphs)]
+    out = {"graphs": n_graphs, "per_producer_batches": per_producer,
+           "rows_per_batch": rows_per_batch, "key_space": key_space,
+           "devices": n_devices, "platform": platform,
+           "ge_slack": ge_slack}
+
+    # -- A: single-device baseline ----------------------------------------
+    base_wall, base_tables, base_stats = run_tier(None)
+    base_diff = max(max_diff(t, w) for t, w in zip(base_tables, want))
+    base_rate = total_rows / base_wall
+    out["single_rows_per_s"] = round(base_rate)
+    out["single_windows"] = base_stats["windows"]
+    out["single_fallbacks"] = base_stats["fallbacks"]
+    log(f"shardserve[single]: {total_rows} rows in {base_wall:.3f}s "
+        f"({base_rate:.0f} rows/s, windows={base_stats['windows']}, "
+        f"fallbacks={base_stats['fallbacks']})")
+
+    # -- B: 8 spread tenants ----------------------------------------------
+    spread_wall, spread_tables, spread_stats = run_tier("spread")
+    spread_diff = max(max_diff(t, w)
+                      for t, w in zip(spread_tables, want))
+    spread_rate = total_rows / spread_wall
+    out["spread_rows_per_s"] = round(spread_rate)
+    out["spread_vs_single_x"] = round(spread_rate / base_rate, 3)
+    out["spread_ge_baseline"] = bool(
+        spread_rate >= ge_slack * base_rate)
+    out["spread_windows"] = spread_stats["windows"]
+    out["spread_fallbacks"] = spread_stats["fallbacks"]
+    out["spread_cache_hits"] = spread_stats["cache_hits"]
+    out["spread_devices"] = spread_stats["devices"]
+    out["spread_devices_distinct"] = bool(
+        len(spread_stats["devices"]) == min(n_graphs, n_devices))
+    out["spread_max_abs_diff"] = spread_diff
+    log(f"shardserve[spread]: {spread_wall:.3f}s "
+        f"({spread_rate:.0f} rows/s, {out['spread_vs_single_x']}x, "
+        f"devices={len(spread_stats['devices'])}, "
+        f"cache_hits={spread_stats['cache_hits']}, "
+        f"fallbacks={spread_stats['fallbacks']})")
+
+    # -- C: one sharded hot tenant ----------------------------------------
+    from reflow_tpu.parallel.shard import ShardedTpuExecutor
+
+    tier = ServeTier(max_bytes=64 << 20, pump_threads=2)
+    g, src, r = build()
+    hot = DirtyScheduler(g, ShardedTpuExecutor())
+    h = tier.register("hot", hot, GraphConfig(window=window))
+    sharded_wall = drive([(h, src, gi) for gi in range(n_graphs)])
+    sharded_tab = table(hot, r)
+    sharded_stats = {
+        "windows": hot.megatick_windows,
+        "fallbacks": hot.megatick_fallbacks,
+        "device": hot.executor.device_label,
+    }
+    tier.close()
+    want_all = oracle(range(n_graphs))
+    sharded_diff = max_diff(sharded_tab, want_all)
+    sharded_rate = total_rows / sharded_wall
+    out["sharded_rows_per_s"] = round(sharded_rate)
+    out["sharded_vs_single_x"] = round(sharded_rate / base_rate, 3)
+    out["sharded_ge_baseline"] = bool(
+        sharded_rate >= ge_slack * base_rate)
+    out["sharded_windows"] = sharded_stats["windows"]
+    out["sharded_fallbacks"] = sharded_stats["fallbacks"]
+    out["sharded_device"] = sharded_stats["device"]
+    out["sharded_max_abs_diff"] = sharded_diff
+    log(f"shardserve[sharded {sharded_stats['device']}]: "
+        f"{sharded_wall:.3f}s ({sharded_rate:.0f} rows/s, "
+        f"{out['sharded_vs_single_x']}x, "
+        f"windows={sharded_stats['windows']}, "
+        f"fallbacks={sharded_stats['fallbacks']})")
+
+    # hard correctness: exact per-tick view parity + no silent fallback
+    assert base_diff == 0.0, f"baseline views diverged: {base_diff}"
+    assert spread_diff == 0.0, f"spread views diverged: {spread_diff}"
+    assert sharded_diff == 0.0, f"sharded views diverged: {sharded_diff}"
+    fb = (base_stats["fallbacks"] + spread_stats["fallbacks"]
+          + sharded_stats["fallbacks"])
+    assert fb == 0, f"window path fell back {fb}x on the happy path"
+    assert spread_stats["windows"] > 0 and sharded_stats["windows"] > 0
+    out["views_match"] = True
+    return out
+
+
 def run_control_bench() -> dict:
     """Self-healing control-plane step-load scenario (docs/guide.md
     "Control plane"), two phases, both under a LIVE ``ControlPlane``
@@ -1677,6 +1908,26 @@ def main() -> None:
         _emit({
             "metric": "tier_rows_per_s_4g_2threads",
             "value": out["tier_rows_per_s_4g_2threads"],
+            "unit": "rows/s",
+            **out,
+        }, json_out)
+        return
+
+    if os.environ.get("REFLOW_BENCH_SHARDSERVE") == "1":
+        # pod-scale serving mode: on cpu, force 8 host devices BEFORE jax
+        # imports so the spread/sharded tiers have a mesh to span (a real
+        # TPU platform uses its native device set)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        if "cpu" in os.environ.get("JAX_PLATFORMS", ""):
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
+        out = run_shardserve_bench()
+        _emit({
+            "metric": "shardserve_spread_rows_per_s",
+            "value": out["spread_rows_per_s"],
             "unit": "rows/s",
             **out,
         }, json_out)

@@ -179,6 +179,13 @@ def make_scan_program(tick_fn):
     program amortizes it K-fold — the "macro-tick" streaming fast path.
     Sink-free graphs only (the caller guards): per-tick sink egress
     would otherwise need stacking and per-tick host materialization.
+
+    The ingress stack is DONATED alongside the state pytree (the
+    mega-tick queue's buffers would otherwise stay live across the whole
+    window execution — one extra copy per source) and a fresh zeroed
+    stack rides back out in (potentially) the same memory, so the
+    persistent ingress queue can re-bind it (``run_window``) and keep
+    slot-writing in place.
     """
     import jax
 
@@ -190,9 +197,9 @@ def make_scan_program(tick_fn):
             return states2, (iters, rows, conv)
 
         states, ys = jax.lax.scan(body, op_states, ing_stack)
-        return states, ys
+        return states, ys, jax.tree.map(jnp.zeros_like, ing_stack)
 
-    return jax.jit(scan_fn, donate_argnums=0)
+    return jax.jit(scan_fn, donate_argnums=(0, 1))
 
 
 class _MacroTickMixin:
@@ -200,7 +207,9 @@ class _MacroTickMixin:
     set ``self.tick_fn`` (the unjitted tick) in ``__init__``."""
 
     def call_many(self, op_states, ing_stack, n_ticks: int):
-        """-> (states', (iters[K], rows[K], converged[K]))."""
+        """-> (states', (iters[K], rows[K], converged[K]), fresh_stack).
+        ``ing_stack`` is donated; ``fresh_stack`` is the zeroed
+        replacement the ingress queue re-binds."""
         cache = getattr(self, "_many_cache", None)
         if cache is None:
             cache = self._many_cache = {}

@@ -22,10 +22,20 @@ window) with index-updates into persistent device buffers:
   path uses (``arena.propagate_plan_caps``) BEFORE any device memory is
   reserved.
 
-The buffers are deliberately NOT donated to the window program (only
-the state pytree is), so they survive the dispatch and the next window
-writes in place. Donating them (saving one aliasing copy per window) is
-a known follow-up.
+The buffers ARE donated to the window program (alongside the state
+pytree): the program hands back a fresh zeroed stack in (potentially)
+the same device memory, and the caller re-binds it into the queue
+(:meth:`DeviceIngressQueue.rebind`), so the window no longer holds an
+extra live copy of every source buffer across the dispatch.
+
+``placement`` pins the buffers: a ``jax.Device`` commits them (and the
+zero images, and therefore every slot write and the window program
+itself) to that device — the serve tier's tenant-placement path — and a
+``(mesh, axis)`` pair gives them a ``NamedSharding`` along the delta
+(capacity) axis, so slot writes and padding land shard-local and the
+window program runs SPMD over the mesh (the sharded hot-tenant path).
+Bucketed capacities are powers of two >= MIN_CAPACITY >= the mesh size,
+so the capacity axis always divides.
 
 ``slot_nbytes`` is the admission-side view of the same reservation: the
 device bytes one host batch will occupy in its queue slot, used by the
@@ -65,20 +75,31 @@ def _write_slot(bufs: DeviceDelta, t, keys, values, weights) -> DeviceDelta:
                        bufs.weights.at[t].set(weights))
 
 
+# one writer for every queue: jax caches the compiled update per
+# (shape, dtype, sharding), so same-shaped queues across graphs (and
+# devices) share the compilation instead of re-jitting per queue
+_WRITER = jax.jit(_write_slot, donate_argnums=0)
+
+
 class DeviceIngressQueue:
     """Per-source [K, cap] delta buffers plus their jitted slot writer.
 
     ``specs``/``caps`` map source node ids to their Spec and padded
     per-tick row capacity; ``k`` is the window length in ticks.
+    ``placement`` is None (default device), a ``jax.Device`` (commit the
+    buffers — and every dispatch over them — to that device), or a
+    ``(mesh, axis)`` pair (NamedSharding the capacity axis over the
+    mesh's ``axis``).
     """
 
     def __init__(self, specs: Dict[int, object], caps: Dict[int, int],
-                 k: int):
+                 k: int, placement=None):
         import jax.numpy as jnp
 
         self.k = int(k)
         self.caps = dict(caps)
         self._specs = dict(specs)
+        self.placement = placement
         self._bufs: Dict[int, DeviceDelta] = {}
         self._zero: Dict[int, tuple] = {}
         self.writes = 0
@@ -88,16 +109,36 @@ class DeviceIngressQueue:
             spec = specs[nid]
             vshape = tuple(spec.value_shape)
             self._bufs[nid] = DeviceDelta(
-                jnp.zeros((k, cap), jnp.int32),
-                jnp.zeros((k, cap) + vshape, spec.value_dtype),
-                jnp.zeros((k, cap), jnp.int32))
+                self._put(jnp.zeros((k, cap), jnp.int32), stacked=True),
+                self._put(jnp.zeros((k, cap) + vshape, spec.value_dtype),
+                          stacked=True),
+                self._put(jnp.zeros((k, cap), jnp.int32), stacked=True))
             # the padding image: device-resident so an empty slot's write
             # is a pure on-device index-update (zero host bytes moved)
-            self._zero[nid] = (jnp.zeros((cap,), jnp.int32),
-                               jnp.zeros((cap,) + vshape, spec.value_dtype),
-                               jnp.zeros((cap,), jnp.int32))
+            self._zero[nid] = (
+                self._put(jnp.zeros((cap,), jnp.int32), stacked=False),
+                self._put(jnp.zeros((cap,) + vshape, spec.value_dtype),
+                          stacked=False),
+                self._put(jnp.zeros((cap,), jnp.int32), stacked=False))
             self.nbytes += k * slot_nbytes(spec, cap)
-        self._writer = jax.jit(_write_slot, donate_argnums=0)
+        self._writer = _WRITER
+
+    def _put(self, x, *, stacked: bool):
+        """Apply the queue's placement to one freshly-allocated buffer:
+        commit to the pinned device, or shard the capacity axis (dim 1 of
+        a [K, cap, ...] stack, dim 0 of a [cap, ...] zero image) over the
+        mesh. None = wherever jax's default device is."""
+        if self.placement is None:
+            return x
+        if isinstance(self.placement, tuple):
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            mesh, axis = self.placement
+            dims = ((None, axis) if stacked else (axis,))
+            dims = dims + (None,) * (x.ndim - len(dims))
+            return jax.device_put(x, NamedSharding(mesh,
+                                                   PartitionSpec(*dims)))
+        return jax.device_put(x, self.placement)
 
     def write(self, t: int, nid: int, batch) -> None:
         """Fill slot ``(t, nid)`` from a host batch (zero-row batches
@@ -132,3 +173,23 @@ class DeviceIngressQueue:
         window program scans — same pytree shape ``_stack_feeds``
         produces, so the compiled programs are shared between paths."""
         return dict(self._bufs)
+
+    def rebind(self, stacked: Dict[int, DeviceDelta]) -> None:
+        """Adopt the window program's returned (zeroed, donated-memory)
+        stack as the queue's buffers. The stack the program consumed was
+        DONATED — the old buffer handles are dead — so the caller must
+        hand the pass-through output back here before the next write."""
+        if sorted(stacked) != sorted(self._bufs):
+            raise ValueError(
+                f"rebind stack keys {sorted(stacked)} != queue sources "
+                f"{sorted(self._bufs)}")
+        # re-assert the queue's placement on the adopted buffers: the
+        # compiler picks the window program's output sharding freely, so
+        # a sharded stack can come back replicated — a no-op when the
+        # sharding already matches, a one-time reshard when it doesn't
+        # (without it, every later slot write loses shard-locality).
+        if self.placement is not None:
+            stacked = {nid: jax.tree.map(
+                lambda x: self._put(x, stacked=True), dd)
+                for nid, dd in stacked.items()}
+        self._bufs = dict(stacked)

@@ -892,7 +892,10 @@ class LinearFixpointProgram(_MacroTickMixin):
 
     def call_many(self, op_states, ing_stack, n_ticks: int):
         """K ticks in ONE device execution, CSR cache carried through the
-        scan. -> (states', (iters[K], rows[K], converged[K]))."""
+        scan. -> (states', (iters[K], rows[K], converged[K]),
+        fresh_stack) — the ingress stack is donated (mega-tick queue
+        buffers stop living across the dispatch) and the zeroed
+        replacement rides back for the queue to re-bind."""
         cache = getattr(self, "_many_cache", None)
         if cache is None:
             cache = self._many_cache = {}
@@ -909,9 +912,12 @@ class LinearFixpointProgram(_MacroTickMixin):
 
                 (states, csr), ys = jax.lax.scan(body, (op_states, csr),
                                                  ing_stack)
-                return states, csr, ys
+                return states, csr, ys, jax.tree.map(jnp.zeros_like,
+                                                     ing_stack)
 
-            prog = cache[n_ticks] = jax.jit(scan_fn, donate_argnums=(0, 1))
-        states, csr, ys = prog(op_states, self._take_csr(), ing_stack)
+            prog = cache[n_ticks] = jax.jit(scan_fn,
+                                            donate_argnums=(0, 1, 2))
+        states, csr, ys, fresh = prog(op_states, self._take_csr(),
+                                      ing_stack)
         self._executor._csr_cache[self._join_id] = csr
-        return states, ys
+        return states, ys, fresh

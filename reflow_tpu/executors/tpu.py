@@ -12,11 +12,16 @@ one scalar liveness count per pass for the scheduler's quiescence check
 
 Compiled pass programs are cached per (plan, ingress-capacity-bucket)
 signature, so steady-state ticks hit the cache and pay zero tracing cost.
+Mega-tick window programs additionally share a process-wide cache keyed
+on the plan *signature* (graph structure + fn code, not node identity),
+so structurally-identical tenants — e.g. K spread-placed twins on a
+serving tier — trace their window program once (``megatick_cache_hits``).
 """
 
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -33,6 +38,87 @@ from reflow_tpu.graph import FlowGraph, GraphError, Node
 from reflow_tpu.obs import trace as _trace
 
 __all__ = ["TpuExecutor"]
+
+
+# -- process-wide window-program sharing (plan-signature cache) ------------
+#
+# Two graphs built by the same code are distinct Node objects with
+# distinct (per-graph) ids, so the per-executor program cache cannot see
+# that their dirty plans are the same computation. The signature below
+# captures everything the traced window program can observe — node
+# structure, op configuration, fn CODE identity (plus captured scalar
+# cells), specs, plan positions, capacities — so identical tenants share
+# one traced program object (jax then caches compiled executables per
+# argument sharding/device underneath, so the share also spans devices).
+# Anything the tokenizer can't prove shareable (arrays or rich objects in
+# a closure, fn-less callables) falls back to the per-executor cache.
+
+_SHARED_WINDOW_PROGRAMS: Dict[tuple, object] = {}
+_SHARED_WINDOW_LOCK = threading.Lock()
+
+
+class _Unshareable(Exception):
+    pass
+
+
+def _value_token(v):
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return v
+    if isinstance(v, tuple):
+        return tuple(_value_token(x) for x in v)
+    import numpy as np
+
+    if isinstance(v, np.generic):
+        return (str(v.dtype), v.item())
+    if isinstance(v, np.dtype) or (isinstance(v, type)
+                                   and issubclass(v, np.generic)):
+        return str(np.dtype(v))
+    if callable(v):
+        return _fn_token(v)
+    raise _Unshareable
+
+
+def _fn_token(fn):
+    """Identity of a user fn AS TRACED: its code object plus the values
+    it closes over / defaults to. Two lambdas from the same source line
+    share the code object; differing captured scalars split the token."""
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        raise _Unshareable
+    toks = [_value_token(c.cell_contents) for c in (fn.__closure__ or ())]
+    toks += [_value_token(d) for d in (fn.__defaults__ or ())]
+    return ("fn", code, tuple(toks))
+
+
+def _spec_token(spec):
+    import numpy as np
+
+    return (tuple(spec.value_shape), str(np.dtype(spec.value_dtype)),
+            int(spec.key_space), bool(spec.unique))
+
+
+def _op_token(op):
+    toks = [type(op).__name__]
+    for k in sorted(vars(op)):
+        v = vars(op)[k]
+        if k in ("params", "param_specs"):
+            # params are program ARGUMENTS (op state), not traced
+            # constants: only their presence shapes the program
+            toks.append((k, v is not None))
+        elif hasattr(v, "value_shape"):
+            toks.append((k, _spec_token(v)))
+        else:
+            toks.append((k, _value_token(v)))
+    return tuple(toks)
+
+
+def _node_token(node: Node):
+    # node.name is observability-only (error strings), deliberately out
+    return (node.id, node.kind,
+            _op_token(node.op) if node.op is not None else None,
+            tuple(i.id for i in node.inputs), _spec_token(node.spec),
+            node.back_input.id if node.back_input is not None else None,
+            node.sharding, node.stage, node.defer_passes)
 
 
 class TpuExecutor(Executor):
@@ -69,6 +155,51 @@ class TpuExecutor(Executor):
             "REFLOW_MEGATICK_MAX_ROWS", str(1 << 16)))
         #: windows dispatched through the device-resident ingress queue
         self.window_dispatches = 0
+        #: tenant placement: the jax.Device this executor's state, ingress
+        #: uploads, queue buffers — and therefore every compiled program's
+        #: execution — are committed to. None = jax's default device. Set
+        #: via :meth:`place` (the serve tier's GraphConfig placement path).
+        self.device = None
+        #: window programs adopted from the process-wide plan-signature
+        #: cache instead of traced locally (surfaced as a scheduler gauge)
+        self.megatick_cache_hits = 0
+
+    #: subclasses whose traced programs close over executor-specific
+    #: context (e.g. the sharded executor's mesh/axis in ``_lower``) must
+    #: opt out of the process-wide window-program share
+    _share_window_programs = True
+
+    # -- tenant placement --------------------------------------------------
+
+    def place(self, device) -> None:
+        """Commit this executor to one device: states move, and every
+        subsequent upload, queue buffer, and compiled-program execution
+        follows them (jit dispatch targets the committed argument device).
+        ``device`` is a ``jax.Device`` or an index into ``jax.devices()``.
+        Compiled programs and cached queues reference buffers on the old
+        device, so the program cache is dropped; call between windows."""
+        if isinstance(device, int):
+            device = jax.devices()[device]
+        self.device = device
+        self._cache.clear()
+        self._csr_cache.clear()
+        if self.states:
+            self.states = jax.device_put(self.states, device)
+
+    @property
+    def device_label(self) -> Optional[str]:
+        """Short obs tag for spans/gauges: ``"cpu:3"``-style for a pinned
+        executor, None when running on the default device."""
+        d = self.device
+        if d is None:
+            return None
+        return f"{getattr(d, 'platform', 'dev')}:{getattr(d, 'id', '?')}"
+
+    def _ingress_placement(self):
+        """Placement handed to ingress buffers (queue slots, stacked
+        feeds): the pinned device here; the sharded subclass returns its
+        ``(mesh, axis)`` so the capacity axis lands shard-local."""
+        return self.device
 
     # -- bind: validate lowerability, build device state -------------------
 
@@ -171,6 +302,10 @@ class TpuExecutor(Executor):
                 self.states[node.id] = join_state(op, in_specs[0], in_specs[1])
             else:
                 raise GraphError(f"{node}: no TPU lowering for {op.kind}")
+        if self.device is not None:
+            # placed BEFORE bind: move the freshly-built state tree onto
+            # the pinned device (the jnp.zeros above land on the default)
+            self.states = jax.device_put(self.states, self.device)
 
     # -- one pass ----------------------------------------------------------
 
@@ -179,9 +314,16 @@ class TpuExecutor(Executor):
         dev_ingress: Dict[int, DeviceDelta] = {}
         for nid, b in ingress.items():
             if isinstance(b, DeviceDelta):
+                # jit dispatch follows committed args: a pinned executor
+                # pulls a stray default-device batch over (no-op when it
+                # already lives on self.device)
+                if self.device is not None:
+                    b = jax.tree.map(
+                        lambda x: jax.device_put(x, self.device), b)
                 dev_ingress[nid] = b
             else:
-                dev_ingress[nid] = to_device(b, self.graph.nodes[nid].spec)
+                dev_ingress[nid] = to_device(b, self.graph.nodes[nid].spec,
+                                             device=self.device)
         return dev_ingress
 
     def run_pass(self, plan: Sequence[Node],
@@ -257,7 +399,8 @@ class TpuExecutor(Executor):
         if _trace.ENABLED:
             _trace.evt("device_dispatch", t_d0,
                        time.perf_counter() - t_d0,
-                       args={"kind": "fixpoint"})
+                       args={"kind": "fixpoint",
+                             "device": self.device_label})
         self.states = new_states
         exit_passes = 1 if st.exit_plan else 0
         leftover = {}
@@ -408,7 +551,7 @@ class TpuExecutor(Executor):
             self._track_arena(plan, caps)
             queue = DeviceIngressQueue(
                 {nid: self.graph.nodes[nid].spec for nid in node_ids},
-                caps, K)
+                caps, K, placement=self._ingress_placement())
             self._cache[qsig] = queue
 
         t_h0 = time.perf_counter() if _trace.ENABLED else 0.0
@@ -418,19 +561,44 @@ class TpuExecutor(Executor):
         if _trace.ENABLED:
             _trace.evt("queue_write", t_h0, time.perf_counter() - t_h0,
                        args={"ticks": K, "slots": K * len(node_ids)})
-        out = self._dispatch_many(plan, queue.stacked(), caps, K,
-                                  max_iters, window=True)
+        try:
+            out = self._dispatch_many(plan, queue.stacked(), caps, K,
+                                      max_iters, window=True, queue=queue)
+        except Exception:
+            # the stack was DONATED: if the dispatch died mid-flight the
+            # queue's buffers are gone — drop it so the next window
+            # allocates fresh instead of writing into deleted arrays
+            self._cache.pop(qsig, None)
+            raise
         if out is not None:
             self.window_dispatches += 1
         return out
 
+    def _window_signature(self, plan, caps) -> Optional[tuple]:
+        """Process-wide share key for a loop-free window program: the
+        whole graph's structural tokens plus the plan positions and
+        capacity buckets. None when sharing is off for this executor or
+        any node resists tokenization (``_Unshareable``) — those fall
+        back to the per-executor cache, never to a wrong share."""
+        if not self._share_window_programs or self.graph is None:
+            return None
+        try:
+            nodes = tuple(_node_token(n) for n in self.graph.nodes)
+        except _Unshareable:
+            return None
+        return ("pass_many", nodes, tuple(n.id for n in plan),
+                tuple(sorted(caps.items())))
+
     def _dispatch_many(self, plan, stack, caps, K, max_iters, *,
-                       window: bool = False):
+                       window: bool = False, queue=None):
         """Shared macro-tick dispatch tail: compile (or reuse) the K-tick
         scan program for ``plan``/``caps``, run it over the [K, C]
         ingress ``stack``, and return the scheduler-facing
         ``(passes_base, iters, rows, converged, extra_dirty)`` tuple
         (None when the fixpoint program lacks a fused ``call_many``).
+        The stack is DONATED to the program; when ``queue`` is given the
+        program's returned fresh (zeroed) stack is re-bound into it, so
+        the ingress queue and the window never hold two live copies.
         ``window=True`` tags the dispatch span as the mega-tick path and
         wraps it in a ``jax.profiler`` annotation so Perfetto lines host
         stages up against device occupancy."""
@@ -444,29 +612,52 @@ class TpuExecutor(Executor):
                    tuple(sorted(caps.items())))
             prog = self._cache.get(sig)
             if prog is None:
-                pass_fn = self.build_pass_fn(list(plan))
+                shared_sig = self._window_signature(plan, caps)
+                if shared_sig is not None:
+                    with _SHARED_WINDOW_LOCK:
+                        prog = _SHARED_WINDOW_PROGRAMS.get(shared_sig)
+                if prog is not None:
+                    # a structurally-identical tenant already traced this
+                    # window — adopt its program (jax compiles per
+                    # device/sharding underneath, so cross-device is fine)
+                    self.megatick_cache_hits += 1
+                else:
+                    pass_fn = self.build_pass_fn(list(plan))
 
-                def scan_fn(op_states, ing_stack):
-                    def body(states, ing):
-                        states2, egress = pass_fn(states, ing)
-                        assert not egress, ("loop-free sink-free pass "
-                                            "produced egress")
-                        return states2, ()
+                    def scan_fn(op_states, ing_stack):
+                        def body(states, ing):
+                            states2, egress = pass_fn(states, ing)
+                            assert not egress, ("loop-free sink-free pass "
+                                                "produced egress")
+                            return states2, ()
 
-                    states, _ = jax.lax.scan(body, op_states, ing_stack)
-                    return states
+                        states, _ = jax.lax.scan(body, op_states, ing_stack)
+                        # hand back a FRESH zeroed stack: the input was
+                        # donated, and returning new zeros (not the dead
+                        # input) lets XLA alias the donated memory while
+                        # giving the ingress queue valid buffers to adopt
+                        import jax.numpy as jnp
+                        return states, jax.tree.map(jnp.zeros_like,
+                                                    ing_stack)
 
-                prog = jax.jit(scan_fn, donate_argnums=0)
+                    prog = jax.jit(scan_fn, donate_argnums=(0, 1))
+                    if shared_sig is not None:
+                        with _SHARED_WINDOW_LOCK:
+                            prog = _SHARED_WINDOW_PROGRAMS.setdefault(
+                                shared_sig, prog)
                 self._cache[sig] = prog
             self._track_arena(plan, caps)
             kind = "window" if window else "pass_many"
             t_d0 = time.perf_counter() if _trace.ENABLED else 0.0
             with profile_annotation(f"reflow.window[{K}]", enabled=window):
-                self.states = prog(dict(self.states), stack)
+                self.states, fresh = prog(dict(self.states), stack)
+            if queue is not None:
+                queue.rebind(fresh)
             if _trace.ENABLED:
                 _trace.evt("device_dispatch", t_d0,
                            time.perf_counter() - t_d0,
-                           args={"kind": kind, "ticks": K})
+                           args={"kind": kind, "ticks": K,
+                                 "device": self.device_label})
             return K, 0, 0, True, set()
 
         sig = ("fx", tuple(n.id for n in plan),
@@ -490,12 +681,15 @@ class TpuExecutor(Executor):
         kind = "window" if window else "fixpoint_many"
         t_d0 = time.perf_counter() if _trace.ENABLED else 0.0
         with profile_annotation(f"reflow.window[{K}]", enabled=window):
-            new_states, (iters, rows, conv) = prog.call_many(
+            new_states, (iters, rows, conv), fresh = prog.call_many(
                 dict(self.states), stack, K)
+        if queue is not None:
+            queue.rebind(fresh)
         if _trace.ENABLED:
             _trace.evt("device_dispatch", t_d0,
                        time.perf_counter() - t_d0,
-                       args={"kind": kind, "ticks": K})
+                       args={"kind": kind, "ticks": K,
+                             "device": self.device_label})
         self.states = new_states
         extra_dirty = set(st.region_ids) | {n.id for n in st.exit_plan}
         passes_base = K * (1 + (1 if st.exit_plan else 0))
@@ -503,10 +697,26 @@ class TpuExecutor(Executor):
 
     def _stack_feeds(self, feeds):
         """Host-side [K, C] stacking of K per-tick ingress dicts: ONE
-        transfer per ingress column instead of K separate uploads."""
+        transfer per ingress column instead of K separate uploads. The
+        upload follows the executor's ingress placement (pinned device,
+        or sharded capacity axis on the mesh subclass)."""
         import numpy as _np
 
         import jax.numpy as _jnp
+
+        place = self._ingress_placement()
+
+        def _up(x):
+            if place is None:
+                return _jnp.asarray(x)
+            if isinstance(place, tuple):
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                mesh, axis = place
+                dims = (None, axis) + (None,) * (x.ndim - 2)
+                return jax.device_put(
+                    x, NamedSharding(mesh, PartitionSpec(*dims)))
+            return jax.device_put(x, place)
 
         K = len(feeds)
         stack = {}
@@ -528,9 +738,7 @@ class TpuExecutor(Executor):
                     weights[t, :n] = b.weights
                     values[t, :n] = _np.asarray(b.values).reshape(
                         (n,) + tuple(spec.value_shape))
-            stack[nid] = DeviceDelta(_jnp.asarray(keys),
-                                     _jnp.asarray(values),
-                                     _jnp.asarray(weights))
+            stack[nid] = DeviceDelta(_up(keys), _up(values), _up(weights))
         return stack, caps
 
     def _build_fixpoint(self, plan, caps, max_iters):
@@ -581,8 +789,11 @@ class TpuExecutor(Executor):
 
         if node.id not in self.states or "params" not in self.states[node.id]:
             raise GraphError(f"{node} holds no params state")
-        self.states[node.id] = {
+        fresh = {
             "params": jax.tree.map(lambda x: jnp.array(x, copy=True), params)}
+        if self.device is not None:
+            fresh = jax.device_put(fresh, self.device)
+        self.states[node.id] = fresh
 
     def on_states_replaced(self) -> None:
         """Checkpoint restore swapped the state tree: drop the sorted-arena
@@ -603,7 +814,7 @@ class TpuExecutor(Executor):
         scheduler wrapper — the one call site)."""
         from reflow_tpu.executors.lowerings import minmax_refresh_core
 
-        d = to_device(batch, node.inputs[0].spec)
+        d = to_device(batch, node.inputs[0].spec, device=self.device)
         K = node.inputs[0].spec.key_space
         sig = ("mmrefresh", node.id, d.capacity)
         fn = self._cache.get(sig)

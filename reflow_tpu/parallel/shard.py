@@ -98,6 +98,30 @@ class ShardedTpuExecutor(TpuExecutor):
                 f"{MIN_CAPACITY} so bucketed delta capacities shard evenly")
         self._arena_divisor = self.n
 
+    #: sharded pass programs close over this executor's mesh/axis (via
+    #: ``_lower`` and ``_state_tree_specs``), so the process-wide
+    #: window-program share would cross-wire meshes — per-executor only
+    _share_window_programs = False
+
+    def place(self, device) -> None:
+        """A sharded executor spans the whole mesh — it cannot be pinned
+        to one device. Use a plain TpuExecutor for tenant placement, or
+        the sharded path for one hot tenant across the mesh."""
+        raise GraphError(
+            "ShardedTpuExecutor spans the device mesh and cannot be "
+            "placed on a single device; use TpuExecutor with "
+            "GraphConfig(device=...) / placement='spread' instead")
+
+    @property
+    def device_label(self) -> str:
+        return f"mesh[{self.n}]"
+
+    def _ingress_placement(self):
+        # queue buffers / stacked feeds shard their capacity axis over
+        # the mesh so slot writes and padding land shard-local and the
+        # window program dispatches SPMD
+        return (self.mesh, self.axis)
+
     # -- bind: divisibility validation + sharded state placement -----------
 
     def bind(self, graph: FlowGraph) -> None:

@@ -592,6 +592,8 @@ class DirtyScheduler:
         reg.gauge(f"{key}.megatick_windows", lambda: self.megatick_windows)
         reg.gauge(f"{key}.megatick_fallbacks",
                   lambda: self.megatick_fallbacks)
+        reg.gauge(f"{key}.megatick_cache_hits",
+                  lambda: getattr(self.executor, "megatick_cache_hits", 0))
         return key
 
     def rederive(self, source: Node, batch: DeltaBatch):

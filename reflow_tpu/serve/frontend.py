@@ -651,6 +651,12 @@ class IngestFrontend:
         self._idle.notify_all()
         self._not_full.notify_all()
 
+    def _device_label(self) -> Optional[str]:
+        """Executing-device obs tag for this graph's spans (placement
+        skew shows up in trace_inspect's per-device breakdown)."""
+        return getattr(getattr(self.sched, "executor", None),
+                       "device_label", None)
+
     def _run_window(self, drained: Dict[int, List[Entry]]) -> None:
         self._window_entries = drained  # crash path fails their tickets
         tr = _trace.ENABLED
@@ -693,7 +699,8 @@ class IngestFrontend:
                 _trace.evt("pump_execute", t_exec0, t_exec1 - t_exec0,
                            args={"graph": self.name or "frontend",
                                  "ticks": len(chunk), "lsn": lsn,
-                                 "megatick": self.megatick})
+                                 "megatick": self.megatick,
+                                 "device": self._device_label()})
             self._crash_point("pump_after_tick")
             items = []
             for j, f in enumerate(chunk):
@@ -724,7 +731,8 @@ class IngestFrontend:
         if tr:
             _trace.evt("window", t_w0, time.perf_counter() - t_w0,
                        args={"graph": self.name or "frontend",
-                             "feeds": len(feeds)})
+                             "feeds": len(feeds),
+                             "device": self._device_label()})
         self._win_t_ready = None
         with self._lock:
             self.pump_iterations += 1

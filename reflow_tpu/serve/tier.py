@@ -73,6 +73,15 @@ class GraphConfig:
     ``admission`` keys the byte charge: ``"host"`` = payload bytes,
     ``"device"`` = ingress-queue slot bytes, ``"auto"`` = device iff
     the graph's executor advertises the mega-tick window path.
+
+    ``placement`` / ``device`` bind the graph's executor to one mesh
+    device at register time, so K tenants run their mega-tick windows
+    on K chips concurrently instead of serializing on the default
+    device: ``placement="spread"`` round-robins over ``jax.devices()``,
+    ``placement="pin"`` (or just ``device=``) pins to the given
+    ``jax.Device`` / device index. ``"none"`` leaves the executor
+    wherever it already runs — which is also how a sharded hot tenant
+    (``ShardedTpuExecutor``, spanning the mesh) registers.
     """
 
     weight: float = 1.0
@@ -83,6 +92,9 @@ class GraphConfig:
     window: Optional[CoalesceWindow] = None
     crash: Optional[object] = None  # CrashInjector override (tests)
     admission: str = "auto"
+    #: None | jax.Device | int index into jax.devices() (implies "pin")
+    device: Optional[object] = None
+    placement: str = "none"  # "none" | "spread" | "pin"
 
 
 def dwrr_pick(ready: List["GraphHandle"],
@@ -133,6 +145,14 @@ class GraphHandle:
     def weight(self) -> float:
         return self.config.weight
 
+    @property
+    def device_label(self) -> Optional[str]:
+        """Where this graph's windows execute: the executor's obs tag
+        (``"cpu:3"`` for a pinned tenant, ``"mesh[8]"`` for a sharded
+        one, None on the default device)."""
+        return getattr(getattr(self.frontend.sched, "executor", None),
+                       "device_label", None)
+
     def submit(self, source, batch, **kw):
         return self.frontend.submit(source, batch, **kw)
 
@@ -172,6 +192,8 @@ class ServeTier:
         self.budget = AdmissionBudget(max_bytes)
         self._graphs: Dict[str, GraphHandle] = {}
         self._closed = False
+        #: round-robin cursor for placement="spread" registrations
+        self._place_counter = 0
         # -- counters (utils.metrics.summarize_tier) --
         self.windows = 0
         self.pool_crashes = 0
@@ -206,11 +228,38 @@ class ServeTier:
             raise ValueError(
                 f"QoS weight must be positive, got {cfg.weight} "
                 f"for {name!r}")
+        placement = cfg.placement
+        if placement not in ("none", "spread", "pin"):
+            raise ValueError(
+                f"placement must be 'none', 'spread' or 'pin', got "
+                f"{placement!r} for {name!r}")
+        if cfg.device is not None and placement == "none":
+            placement = "pin"  # device= alone means: pin to it
+        if placement == "pin" and cfg.device is None:
+            raise ValueError(
+                f"placement='pin' needs device= for {name!r}")
         with self._lock:
             if self._closed:
                 raise GraphError("tier is closed; register refused")
             if name in self._graphs:
                 raise ValueError(f"graph {name!r} already registered")
+            if placement != "none":
+                ex = getattr(sched, "executor", None)
+                if not hasattr(ex, "place"):
+                    raise GraphError(
+                        f"graph {name!r}: placement={placement!r} needs an "
+                        f"executor with place() (TpuExecutor); "
+                        f"{type(ex).__name__} has none")
+                if placement == "spread":
+                    import jax
+
+                    devs = jax.devices()
+                    dev = devs[self._place_counter % len(devs)]
+                    self._place_counter += 1
+                else:
+                    dev = cfg.device
+                # a sharded executor raises here (it spans the mesh)
+                ex.place(dev)
             share = self.budget.register(
                 name, floor=cfg.floor_bytes, ceiling=cfg.ceiling_bytes)
             try:
@@ -446,7 +495,8 @@ class ServeTier:
                     if _trace.ENABLED:
                         _trace.evt("pool_pick", ready_since,
                                    now - ready_since,
-                                   args={"graph": picked.name})
+                                   args={"graph": picked.name,
+                                         "device": picked.device_label})
                     drained = picked.frontend._take_window(
                         ready_since=ready_since)
                 else:

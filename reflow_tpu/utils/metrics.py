@@ -301,6 +301,7 @@ def summarize_tier(tier) -> TierMetrics:
             policy=fe.policy,
             crashes=h.crashes,
             revives=fe.revives,
+            device=h.device_label,
         )
         per_graph[name] = g
         all_delays.extend(h.sched_delay_s)

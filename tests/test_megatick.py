@@ -227,6 +227,41 @@ def test_pagerank_loop_window_parity():
     np.testing.assert_allclose(ranks_m, ranks_p, atol=1e-6)
 
 
+def test_window_donates_and_rebinds_queue_buffers():
+    """The ingress stack is DONATED to the window program: after each
+    window the queue must have adopted the program's fresh zeroed stack
+    (old handles are dead), and the NEXT window over the same (now
+    zeroed) buffers must still match the oracle — no stale rows, no
+    use-after-donate."""
+    ticks = _ragged_ticks(n_ticks=8)
+    want = _oracle(ticks)
+    got, sched = _window_drive(ticks, k=4)
+    assert got == want
+    assert sched.megatick_windows == 2
+    qkeys = [key for key in sched.executor._cache
+             if isinstance(key, tuple) and key and key[0] == "ingress_q"]
+    queue = sched.executor._cache[qkeys[0]]
+    for dd in queue.stacked().values():
+        # rebind adopted the program's zeroed pass-through: every slot
+        # is blank until the next window writes it
+        assert int(np.asarray(dd.weights).sum()) == 0
+        assert float(np.abs(np.asarray(dd.values)).sum()) == 0.0
+
+
+def test_window_program_shared_across_identical_graphs():
+    """Two tenants with identically-built graphs share ONE traced window
+    program via the plan-signature cache: the second executor records
+    cache hits instead of re-tracing, and its views still match."""
+    ticks = _ragged_ticks(n_ticks=4, seed=9)
+    want = _oracle(ticks)
+    got_a, sched_a = _window_drive(ticks, k=4)
+    got_b, sched_b = _window_drive(ticks, k=4)
+    assert got_a == want and got_b == want
+    assert sched_b.executor.megatick_cache_hits >= 1
+    assert sched_a.megatick_fallbacks == 0
+    assert sched_b.megatick_fallbacks == 0
+
+
 # -- ingress queue unit behavior -------------------------------------------
 
 def test_zero_padding_overwrites_stale_slot():

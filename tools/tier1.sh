@@ -158,4 +158,34 @@ print(f"TIER1 megatick smoke: tick_s_amortized {r['tick_s_amortized']}s "
       f"{r['megatick_windows']} fused windows, views match")
 EOF
 fi
+
+# optional (RUN_BENCH=1): the shardserve smoke — pod-scale serving
+# under 8 forced host devices: spread tenants must land on distinct
+# devices and share window programs (cache hits), the sharded hot
+# tenant must run fused windows across the mesh, views must match the
+# CPU oracle EXACTLY, and no config may fall back. The >=-baseline
+# rows/s acceptance holds on real multi-chip hardware; forced host
+# devices share the CI cores, so here the flags carry the bench's
+# documented cpu slack and the smoke asserts them plus exactness.
+if [ "${RUN_BENCH:-0}" = "1" ] && [ $rc -eq 0 ]; then
+  REFLOW_BENCH_SHARDSERVE=1 REFLOW_BENCH_SMOKE=1 JAX_PLATFORMS=cpu \
+    timeout -k 10 590 python bench.py --json-out /tmp/_t1_shardserve.json \
+    > /dev/null || rc=3
+  python - <<'EOF' || rc=3
+import json
+r = json.load(open("/tmp/_t1_shardserve.json"))
+assert r["views_match"], r
+assert r["spread_max_abs_diff"] == 0.0, r
+assert r["sharded_max_abs_diff"] == 0.0, r
+assert r["spread_fallbacks"] == 0 and r["sharded_fallbacks"] == 0, r
+assert r["spread_devices_distinct"], r
+assert r["spread_cache_hits"] > 0, r
+assert r["spread_ge_baseline"] and r["sharded_ge_baseline"], r
+print(f"TIER1 shardserve smoke: spread {r['spread_rows_per_s']} rows/s "
+      f"on {len(r['spread_devices'])} devices "
+      f"({r['spread_cache_hits']} shared-program hits), sharded "
+      f"{r['sharded_rows_per_s']} rows/s on {r['sharded_device']}, "
+      f"views exact")
+EOF
+fi
 exit $rc

@@ -18,6 +18,9 @@ has two halves:
   committer), spans on the ``wal`` track ran on it (inline barriers) —
   ``offpath_fsync_frac`` is the share of fsync time the pipeline moved
   off the pump, ``fsync_covered_mean`` the group-commit fan-in;
+- **per-device**: ``device_dispatch`` busy time grouped by the executing
+  device (from the placement/sharding tags on dispatch spans) — the
+  placement-skew view of a spread-placed serving tier;
 - **tickets**: the sampled tickets' end-to-end latency decomposed into
   the six pipeline stages (admission → coalesce → sched_delay →
   execute → fsync → resolve), with the **critical path** — stages
@@ -65,10 +68,18 @@ def inspect(path: str) -> dict:
                  if ev.get("ph") == "M"
                  and ev.get("name") == "thread_name"}
     fsync_on, fsync_off, covered = [], [], []
+    # executing-device busy time, from the device tag placement/sharding
+    # stamps onto dispatch-side spans ("(default)" = untagged executor)
+    dev_busy: dict = defaultdict(float)
+    dev_dispatches: dict = defaultdict(int)
     for ev in events:
         if ev.get("ph") == "X":
             by_name[ev.get("name", "?")].append(float(ev.get("dur", 0.0)))
             tracks.add(ev.get("tid"))
+            if ev.get("name") == "device_dispatch":
+                dev = (ev.get("args") or {}).get("device") or "(default)"
+                dev_busy[dev] += float(ev.get("dur", 0.0))
+                dev_dispatches[dev] += 1
             if ev.get("name") == "wal_fsync":
                 dur = float(ev.get("dur", 0.0))
                 if tid_names.get(ev.get("tid")) == "wal-committer":
@@ -129,6 +140,12 @@ def inspect(path: str) -> dict:
         "fsync_covered_mean": (round(sum(covered) / len(covered), 2)
                                if covered else 0.0),
     }
+    dev_total = sum(dev_busy.values())
+    per_device = {
+        dev: {"dispatches": dev_dispatches[dev],
+              "busy_ms": round(busy / 1e3, 3),
+              "share": round(busy / dev_total, 4) if dev_total else 0.0}
+        for dev, busy in sorted(dev_busy.items())}
     return {
         "schema": "reflow.trace_inspect/1",
         "trace_file": path,
@@ -136,6 +153,7 @@ def inspect(path: str) -> dict:
         "tracks": len(tracks),
         "durability": durability,
         "window_dispatch_frac": window_dispatch_frac,
+        "per_device": per_device,
         "control_actions": control_actions,
         "spans": spans,
         "tickets": len(tickets),
@@ -165,6 +183,12 @@ def _print_human(s: dict) -> None:
         print(f"window dispatch fraction: "
               f"{s['window_dispatch_frac']:.0%} of commit-window time "
               f"was device dispatch")
+    if s.get("per_device"):
+        print(f"{'device':<12} {'dispatches':>11} {'busy_ms':>10} "
+              f"{'share':>8}")
+        for dev, d in s["per_device"].items():
+            print(f"{dev:<12} {d['dispatches']:>11} {d['busy_ms']:>10.2f} "
+                  f"{100 * d['share']:>7.1f}%")
     if s["control_actions"]:
         acts = ", ".join(f"{k}={v}"
                          for k, v in s["control_actions"].items())
