@@ -64,6 +64,12 @@ Env knobs::
                                   window_dispatch_s plus view parity
                                   against an identically-fed per-tick
                                   twin (runs on the selected device)
+    REFLOW_BENCH_PIPELINE=1       pipelined-window mode instead: the
+                                  PageRank churn waves through an
+                                  IngestFrontend at window depth 1 vs 2
+                                  on identical batches — amortized tick,
+                                  stage_overlap_frac, EXACT depth parity
+                                  (max_abs_diff == 0), zero fallbacks
     REFLOW_BENCH_SERVE=1          serve mode instead: IngestFrontend
                                   sustained throughput at 1/4/16 concurrent
                                   producers vs the bare push+tick loop,
@@ -469,6 +475,144 @@ def run_megatick_bench() -> dict:
                      "delta_ops": o} for w, d, o in windows],
     }
     log("megatick:", json.dumps(out))
+    return out
+
+
+# -- pipelined-window mode (REFLOW_BENCH_PIPELINE=1) -----------------------
+
+def run_pipeline_bench() -> dict:
+    """Pipelined window execution numbers (docs/guide.md "Pipelined
+    windows"): the PageRank churn workload driven through a standalone
+    ``IngestFrontend`` at window depth 1 (stage and execute strictly
+    alternating — the serial pump) vs depth 2 (stage(N+1) overlaps the
+    in-flight dispatch of window N), on IDENTICAL pre-generated
+    batches. The pause → submit wave → resume → flush protocol forces
+    each wave to drain as one multi-chunk backlog, so consecutive
+    window chunks actually pipeline.
+
+    Per depth: amortized tick wall (flush + device sync over total
+    ticks) and ``stage_overlap_frac``. Across depths: EXACT table
+    parity (``max_abs_diff`` must be 0.0 — same fused program, same
+    slot contents, same dispatch order), zero mega-tick fallbacks, and
+    the not-slower check (depth 2 within 5% of depth 1; on real
+    accelerators the overlap is the win, on CPU it must at least not
+    regress). A per-tick twin on the same executor bounds both drives
+    the way the mega-tick bench does."""
+    from bench_configs import _pad_batch, _settle, _sync_read
+    from reflow_tpu.executors import get_executor
+    from reflow_tpu.scheduler import DirtyScheduler
+    from reflow_tpu.serve import CoalesceWindow, IngestFrontend
+    from reflow_tpu.workloads import pagerank
+
+    p = _params()
+    k = p["stream_ticks"]
+    n_windows = 3     # chunks per measured wave (>= 2 so chunks overlap)
+    n_waves = 2       # measured waves per depth; best wall wins (noise)
+    n_churn = 2 * max(1, int(p["churn"] * p["n_edges"]))
+
+    _, web = _build_pagerank(p["n_nodes"], p["n_edges"], p["churn"],
+                             p["tol"])
+    # mint every batch once (WebGraph.churn mutates its edge set): both
+    # depths and the per-tick twin consume the same list; fixed-row
+    # padding keeps every window on one queue/program signature
+    init = web.initial_batch()
+    churn = [_pad_batch(web.churn(p["churn"]), n_churn)
+             for _ in range((1 + n_waves * n_windows) * k)]
+    warm, measured = churn[:k], churn[k:]
+
+    out = {"executor": "tpu", "nodes": p["n_nodes"],
+           "edges": p["n_edges"], "window_ticks": k,
+           "windows_per_wave": n_windows, "waves": n_waves}
+    tables = {}
+    for d in (1, 2):
+        pr, _ = _build_pagerank(p["n_nodes"], p["n_edges"], p["churn"],
+                                p["tol"])
+        sched = DirtyScheduler(pr.graph, get_executor("tpu"))
+        sched.push(pr.teleport, pagerank.teleport_batch(p["n_nodes"]))
+        sched.push(pr.edges, init)
+        sched.tick(sync=False)                   # cold build (compile)
+        fe = IngestFrontend(
+            sched, max_bytes=1 << 30, depth=d,
+            window=CoalesceWindow(max_rows=n_churn, max_ticks=k,
+                                  max_latency_s=0.005))
+
+        def wave(batches, fe=fe, src=pr.edges, sched=sched):
+            fe.pause()
+            tks = [fe.submit(src, b) for b in batches]
+            t0 = time.perf_counter()
+            fe.resume()
+            fe.flush(timeout=600)
+            _sync_read(sched.executor)
+            wall = time.perf_counter() - t0
+            assert all(t.result(timeout=60).applied for t in tks)
+            return wall
+
+        wave(warm)
+        _settle(0 if p["smoke"] else 5, log, f"depth {d}: warm wave")
+        walls = []
+        for w in range(n_waves):
+            lo = w * n_windows * k
+            walls.append(wave(measured[lo:lo + n_windows * k]))
+        wall = min(walls)
+        ticks = n_windows * k
+        out[f"depth{d}_tick_s_amortized"] = round(wall / ticks, 5)
+        out[f"depth{d}_wave_walls_s"] = [round(w, 4) for w in walls]
+        out[f"depth{d}_windows_staged"] = fe.windows_staged
+        out[f"depth{d}_windows_pipelined"] = fe.windows_pipelined
+        out[f"depth{d}_stage_overlap_frac"] = round(
+            fe.stage_overlap_frac, 4)
+        out[f"depth{d}_megatick_windows"] = sched.megatick_windows
+        out[f"depth{d}_megatick_fallbacks"] = sched.megatick_fallbacks
+        log(f"pipeline[depth {d}]: {wall:.3f}s best wave "
+            f"({out[f'depth{d}_tick_s_amortized']}s/tick; "
+            f"staged {fe.windows_staged}, pipelined "
+            f"{fe.windows_pipelined}, overlap "
+            f"{out[f'depth{d}_stage_overlap_frac']:.0%}, fallbacks "
+            f"{sched.megatick_fallbacks})")
+        fe.close()
+        tables[d] = pagerank.ranks_to_array(
+            sched.read_table(pr.new_rank), p["n_nodes"])
+
+    # per-tick twin on the same executor: the proven-parity reference
+    pr2, _ = _build_pagerank(p["n_nodes"], p["n_edges"], p["churn"],
+                             p["tol"])
+    per = DirtyScheduler(pr2.graph, get_executor("tpu"))
+    per.push(pr2.teleport, pagerank.teleport_batch(p["n_nodes"]))
+    per.push(pr2.edges, init)
+    per.tick(sync=False)
+    results = []
+    for b in churn:
+        per.push(pr2.edges, b)
+        results.append(per.tick(sync=False))
+    _sync_read(per.executor)
+    for r in results:
+        r.block()
+    ranks_t = pagerank.ranks_to_array(per.read_table(pr2.new_rank),
+                                      p["n_nodes"])
+
+    max_abs_diff = float(np.abs(tables[2] - tables[1]).max())
+    twin_diff = float(np.abs(tables[1] - ranks_t).max())
+    out.update({
+        # the acceptance set: depth parity is EXACT, the twin is the
+        # usual float-tolerance check, the pipeline never fell back,
+        # depth 2 genuinely overlapped, and it paid no throughput tax
+        "max_abs_diff": max_abs_diff,
+        "views_match": bool(max_abs_diff == 0.0),
+        "twin_max_abs_diff": twin_diff,
+        "twin_views_match": bool(twin_diff <= 1e-6),
+        "zero_fallbacks": bool(
+            out["depth1_megatick_fallbacks"] == 0
+            and out["depth2_megatick_fallbacks"] == 0),
+        "overlap_at_depth2": bool(
+            out["depth2_stage_overlap_frac"] > 0.0),
+        "depth2_not_slower": bool(
+            out["depth2_tick_s_amortized"]
+            <= 1.05 * out["depth1_tick_s_amortized"]),
+        "depth2_vs_depth1_x": round(
+            out["depth1_tick_s_amortized"]
+            / max(out["depth2_tick_s_amortized"], 1e-9), 3),
+    })
+    log("pipeline:", json.dumps(out))
     return out
 
 
@@ -1990,6 +2134,18 @@ def main() -> None:
             "metric": "wal_recovery_time_to_first_tick_s",
             "value": out["time_to_first_tick_s"],
             "unit": "s",
+            **out,
+        }, json_out)
+        return
+
+    if os.environ.get("REFLOW_BENCH_PIPELINE") == "1":
+        # pipelined-window mode measures the device window path — do NOT
+        # force cpu; the tier-1 smoke sets JAX_PLATFORMS=cpu explicitly
+        out = run_pipeline_bench()
+        _emit({
+            "metric": "pipeline_depth2_vs_depth1_x",
+            "value": out["depth2_vs_depth1_x"],
+            "unit": "x",
             **out,
         }, json_out)
         return

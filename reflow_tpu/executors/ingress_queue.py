@@ -24,9 +24,22 @@ window) with index-updates into persistent device buffers:
 
 The buffers ARE donated to the window program (alongside the state
 pytree): the program hands back a fresh zeroed stack in (potentially)
-the same device memory, and the caller re-binds it into the queue
-(:meth:`DeviceIngressQueue.rebind`), so the window no longer holds an
-extra live copy of every source buffer across the dispatch.
+the same device memory, and the caller hands it back via the retire
+step (:meth:`DeviceIngressQueue.retire`), so the window no longer
+holds an extra live copy of every source buffer across the dispatch.
+
+**Generation rotation (pipelined windows).** The buffers come in
+*generations* — independent full buffer sets. ``write`` targets the
+current *staging* generation; :meth:`seal` hands that generation to a
+dispatch (its buffers now belong to the in-flight window program via
+donation) and the next ``write`` rotates onto a free generation, so
+window N+1's slot writes NEVER touch a buffer set an in-flight program
+owns. :meth:`retire` re-adopts the program's returned zeroed stack
+into the sealed generation and frees it for reuse. Generations are
+allocated lazily: a depth-1 caller (seal → dispatch → retire → seal)
+ping-pongs on generation 0 forever and pays for exactly one buffer
+set, same as before pipelining; a depth-D pump allocates at most D
+sets. The pump bounds the in-flight depth — the queue just rotates.
 
 ``placement`` pins the buffers: a ``jax.Device`` commits them (and the
 zero images, and therefore every slot write and the window program
@@ -45,7 +58,7 @@ instead of host payload bytes.
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -53,8 +66,11 @@ import jax
 
 from reflow_tpu.executors.device_delta import (DeviceDelta, bucket_capacity,
                                                check_weight_mass)
+from reflow_tpu.utils.faults import DeliveryError
 
 __all__ = ["DeviceIngressQueue", "slot_nbytes"]
+
+_I32 = np.iinfo(np.int32)
 
 
 def slot_nbytes(spec, rows: int) -> int:
@@ -80,6 +96,26 @@ def _write_slot(bufs: DeviceDelta, t, keys, values, weights) -> DeviceDelta:
 # devices) share the compilation instead of re-jitting per queue
 _WRITER = jax.jit(_write_slot, donate_argnums=0)
 
+#: does this backend COPY host numpy arguments when they enter a
+#: computation? jaxlib's CPU client can zero-copy aligned host buffers
+#: in some versions, in which case a reused scratch array would alias
+#: live device data and mutating it between slot writes would corrupt
+#: an in-flight window. Probed once, lazily.
+_SCRATCH_REUSE_SAFE: Optional[bool] = None
+
+
+def _scratch_reuse_safe() -> bool:
+    global _SCRATCH_REUSE_SAFE
+    if _SCRATCH_REUSE_SAFE is None:
+        import jax.numpy as jnp
+
+        probe = np.arange(32, dtype=np.int32)
+        dev = jnp.asarray(probe)
+        probe[:] = -1
+        dev.block_until_ready()
+        _SCRATCH_REUSE_SAFE = not bool((np.asarray(dev) < 0).any())
+    return _SCRATCH_REUSE_SAFE
+
 
 class DeviceIngressQueue:
     """Per-source [K, cap] delta buffers plus their jitted slot writer.
@@ -100,28 +136,58 @@ class DeviceIngressQueue:
         self.caps = dict(caps)
         self._specs = dict(specs)
         self.placement = placement
-        self._bufs: Dict[int, DeviceDelta] = {}
-        self._zero: Dict[int, tuple] = {}
         self.writes = 0
         self.zero_writes = 0
+        self.generations = 0
         self.nbytes = 0
+        self.gen_nbytes = sum(k * slot_nbytes(specs[nid], cap)
+                              for nid, cap in caps.items())
+        self._zero: Dict[int, tuple] = {}
         for nid, cap in sorted(caps.items()):
             spec = specs[nid]
             vshape = tuple(spec.value_shape)
-            self._bufs[nid] = DeviceDelta(
-                self._put(jnp.zeros((k, cap), jnp.int32), stacked=True),
-                self._put(jnp.zeros((k, cap) + vshape, spec.value_dtype),
-                          stacked=True),
-                self._put(jnp.zeros((k, cap), jnp.int32), stacked=True))
             # the padding image: device-resident so an empty slot's write
-            # is a pure on-device index-update (zero host bytes moved)
+            # is a pure on-device index-update (zero host bytes moved);
+            # shared read-only across generations
             self._zero[nid] = (
                 self._put(jnp.zeros((cap,), jnp.int32), stacked=False),
                 self._put(jnp.zeros((cap,) + vshape, spec.value_dtype),
                           stacked=False),
                 self._put(jnp.zeros((cap,), jnp.int32), stacked=False))
-            self.nbytes += k * slot_nbytes(spec, cap)
+        #: generation -> {nid: DeviceDelta}; _staging is the generation
+        #: writes land in, _inflight the sealed (donated, program-owned)
+        #: ones in dispatch order, _free the reusable ones (LIFO so the
+        #: depth-1 flow ping-pongs on generation 0)
+        self._gens: List[Dict[int, DeviceDelta]] = []
+        self._free: List[int] = []
+        self._inflight: List[int] = []
+        self._staging: Optional[int] = None
+        self._alloc_gen()  # generation 0, eagerly — same memory as before
+        #: host-side padded staging arrays, one set per source, reused
+        #: across every slot write (kills the three-np.zeros-per-slot
+        #: churn); only when the backend copies host args at dispatch
+        self._scratch: Dict[int, tuple] = {}
+        self._scratch_rows: Dict[int, int] = {}
         self._writer = _WRITER
+
+    def _alloc_gen(self) -> int:
+        import jax.numpy as jnp
+
+        bufs: Dict[int, DeviceDelta] = {}
+        for nid, cap in sorted(self.caps.items()):
+            spec = self._specs[nid]
+            vshape = tuple(spec.value_shape)
+            bufs[nid] = DeviceDelta(
+                self._put(jnp.zeros((self.k, cap), jnp.int32), stacked=True),
+                self._put(jnp.zeros((self.k, cap) + vshape, spec.value_dtype),
+                          stacked=True),
+                self._put(jnp.zeros((self.k, cap), jnp.int32), stacked=True))
+        gen = len(self._gens)
+        self._gens.append(bufs)
+        self._free.append(gen)
+        self.generations += 1
+        self.nbytes += self.gen_nbytes
+        return gen
 
     def _put(self, x, *, stacked: bool):
         """Apply the queue's placement to one freshly-allocated buffer:
@@ -140,49 +206,42 @@ class DeviceIngressQueue:
                                                    PartitionSpec(*dims)))
         return jax.device_put(x, self.placement)
 
-    def write(self, t: int, nid: int, batch) -> None:
-        """Fill slot ``(t, nid)`` from a host batch (zero-row batches
-        write the cached zero image). Every slot must be written every
-        window — the buffers persist, so a skipped slot would replay the
-        previous window's rows."""
-        cap = self.caps[nid]
-        n = len(batch)
-        if n > cap:
-            raise ValueError(
-                f"batch of {n} rows exceeds queue slot capacity {cap} "
-                f"for node {nid}")
-        if n == 0:
-            keys, values, weights = self._zero[nid]
-            self.zero_writes += 1
-        else:
-            check_weight_mass(batch)   # same host-boundary guard as upload
-            spec = self._specs[nid]
-            vshape = tuple(spec.value_shape)
-            keys = np.zeros(cap, np.int32)
-            keys[:n] = batch.keys.astype(np.int64)
-            weights = np.zeros(cap, np.int32)
-            weights[:n] = batch.weights
-            values = np.zeros((cap,) + vshape, spec.value_dtype)
-            values[:n] = np.asarray(batch.values).reshape((n,) + vshape)
-        self._bufs[nid] = self._writer(self._bufs[nid], t, keys, values,
-                                       weights)
-        self.writes += 1
+    # -- generation rotation -----------------------------------------------
 
-    def stacked(self) -> Dict[int, DeviceDelta]:
-        """The queue's current contents as the [K, cap] ingress stack the
-        window program scans — same pytree shape ``_stack_feeds``
-        produces, so the compiled programs are shared between paths."""
-        return dict(self._bufs)
+    @property
+    def in_flight(self) -> int:
+        """Sealed generations currently owned by dispatched programs."""
+        return len(self._inflight)
 
-    def rebind(self, stacked: Dict[int, DeviceDelta]) -> None:
+    def _ensure_staging(self) -> int:
+        if self._staging is None:
+            if not self._free:
+                self._alloc_gen()
+            self._staging = self._free.pop()
+        return self._staging
+
+    def seal(self) -> int:
+        """Hand the staging generation to a dispatch: its buffers now
+        belong to the window program (donation) and the next ``write``
+        rotates onto a free generation. Returns the generation id the
+        caller must :meth:`retire` (or :meth:`cancel`) later."""
+        gen = self._ensure_staging()
+        self._staging = None
+        self._inflight.append(gen)
+        return gen
+
+    def retire(self, gen: int, stacked: Dict[int, DeviceDelta]) -> None:
         """Adopt the window program's returned (zeroed, donated-memory)
-        stack as the queue's buffers. The stack the program consumed was
-        DONATED — the old buffer handles are dead — so the caller must
-        hand the pass-through output back here before the next write."""
-        if sorted(stacked) != sorted(self._bufs):
+        stack back into generation ``gen`` and free it for restaging.
+        The stack the program consumed was DONATED — the old buffer
+        handles are dead — so the caller must hand the pass-through
+        output back here before the generation is written again."""
+        if gen not in self._inflight:
+            raise ValueError(f"generation {gen} is not in flight")
+        if sorted(stacked) != sorted(self.caps):
             raise ValueError(
-                f"rebind stack keys {sorted(stacked)} != queue sources "
-                f"{sorted(self._bufs)}")
+                f"retire stack keys {sorted(stacked)} != queue sources "
+                f"{sorted(self.caps)}")
         # re-assert the queue's placement on the adopted buffers: the
         # compiler picks the window program's output sharding freely, so
         # a sharded stack can come back replicated — a no-op when the
@@ -192,4 +251,96 @@ class DeviceIngressQueue:
             stacked = {nid: jax.tree.map(
                 lambda x: self._put(x, stacked=True), dd)
                 for nid, dd in stacked.items()}
-        self._bufs = dict(stacked)
+        self._gens[gen] = dict(stacked)
+        self._inflight.remove(gen)
+        self._free.append(gen)
+
+    def cancel(self, gen: int) -> None:
+        """Un-seal a generation whose dispatch never happened. Its
+        buffers are still live (nothing was donated), so it goes
+        straight back to the free list — every slot is rewritten every
+        window, so stale rows can't leak."""
+        if gen in self._inflight:
+            self._inflight.remove(gen)
+            self._free.append(gen)
+
+    def rebind(self, stacked: Dict[int, DeviceDelta]) -> None:
+        """Legacy single-generation surface: retire the OLDEST in-flight
+        generation (the depth-1 flow seals exactly one at a time)."""
+        if not self._inflight:
+            raise ValueError("rebind with no sealed generation in flight")
+        self.retire(self._inflight[0], stacked)
+
+    # -- slot writes --------------------------------------------------------
+
+    def write(self, t: int, nid: int, batch) -> None:
+        """Fill slot ``(t, nid)`` of the staging generation from a host
+        batch (zero-row batches write the cached zero image). Every slot
+        must be written every window — the buffers persist, so a skipped
+        slot would replay a previous window's rows."""
+        cap = self.caps[nid]
+        n = len(batch)
+        if n > cap:
+            raise ValueError(
+                f"batch of {n} rows exceeds queue slot capacity {cap} "
+                f"for node {nid}")
+        gen = self._ensure_staging()
+        bufs = self._gens[gen]
+        if n == 0:
+            keys, values, weights = self._zero[nid]
+            self.zero_writes += 1
+        else:
+            check_weight_mass(batch)   # same host-boundary guard as upload
+            bkeys = np.asarray(batch.keys)
+            if bkeys.size and (int(bkeys.max()) > _I32.max
+                               or int(bkeys.min()) < _I32.min):
+                # the slot buffers are int32: assigning int64 keys would
+                # silently wrap anything >= 2^31 — refuse at the host
+                # boundary instead of folding a corrupted key
+                raise DeliveryError(
+                    f"node {nid}: batch keys exceed the int32 ingress "
+                    f"key range [{_I32.min}, {_I32.max}] "
+                    f"(max {int(bkeys.max())}, min {int(bkeys.min())})")
+            keys, values, weights = self._pad_host(nid, n, cap, bkeys, batch)
+        bufs[nid] = self._writer(bufs[nid], t, keys, values, weights)
+        self.writes += 1
+
+    def _pad_host(self, nid: int, n: int, cap: int, bkeys, batch):
+        """Capacity-padded host images of one batch's columns. Reuses a
+        per-source preallocated scratch set (zeroing only the tail the
+        previous fill dirtied) when the backend copies host args at
+        dispatch; falls back to fresh allocations on an aliasing
+        backend, where a reused array could be mutated under an
+        in-flight transfer."""
+        spec = self._specs[nid]
+        vshape = tuple(spec.value_shape)
+        if _scratch_reuse_safe():
+            sc = self._scratch.get(nid)
+            if sc is None:
+                sc = self._scratch[nid] = (
+                    np.zeros(cap, np.int32),
+                    np.zeros((cap,) + vshape, spec.value_dtype),
+                    np.zeros(cap, np.int32))
+                self._scratch_rows[nid] = 0
+            keys, values, weights = sc
+            prev = self._scratch_rows[nid]
+            if prev > n:
+                keys[n:prev] = 0
+                values[n:prev] = 0
+                weights[n:prev] = 0
+            self._scratch_rows[nid] = n
+        else:
+            keys = np.zeros(cap, np.int32)
+            values = np.zeros((cap,) + vshape, spec.value_dtype)
+            weights = np.zeros(cap, np.int32)
+        keys[:n] = bkeys
+        weights[:n] = batch.weights
+        values[:n] = np.asarray(batch.values).reshape((n,) + vshape)
+        return keys, values, weights
+
+    def stacked(self) -> Dict[int, DeviceDelta]:
+        """The staging generation's contents as the [K, cap] ingress
+        stack the window program scans — same pytree shape
+        ``_stack_feeds`` produces, so the compiled programs are shared
+        between paths."""
+        return dict(self._gens[self._ensure_staging()])

@@ -37,7 +37,7 @@ from reflow_tpu.executors.lowerings import (DEVICE_REDUCERS, join_state,
 from reflow_tpu.graph import FlowGraph, GraphError, Node
 from reflow_tpu.obs import trace as _trace
 
-__all__ = ["TpuExecutor"]
+__all__ = ["TpuExecutor", "StagedWindow"]
 
 
 # -- process-wide window-program sharing (plan-signature cache) ------------
@@ -59,6 +59,29 @@ _SHARED_WINDOW_LOCK = threading.Lock()
 
 class _Unshareable(Exception):
     pass
+
+
+class StagedWindow:
+    """A staged-but-not-yet-dispatched K-tick window: the ingress queue
+    generation its slot writes landed in, the [K, cap] stack to hand the
+    window program, and everything :meth:`TpuExecutor.dispatch_window` /
+    :meth:`TpuExecutor.retire_window` need to finish the lifecycle.
+    ``fresh`` is filled by dispatch (the program's returned zeroed
+    pass-through stack) and consumed by retire."""
+
+    __slots__ = ("plan", "caps", "K", "max_iters", "queue", "gen", "stack",
+                 "qsig", "fresh")
+
+    def __init__(self, plan, caps, K, max_iters, queue, gen, stack, qsig):
+        self.plan = plan
+        self.caps = caps
+        self.K = K
+        self.max_iters = max_iters
+        self.queue = queue
+        self.gen = gen
+        self.stack = stack
+        self.qsig = qsig
+        self.fresh = None
 
 
 def _value_token(v):
@@ -519,7 +542,36 @@ class TpuExecutor(Executor):
         doesn't fit (device-resident batches, rows above
         ``megatick_max_rows``, unsupported graph) — the scheduler then
         falls back to the stacked/per-tick paths.
+
+        This is the depth-1 composition of the staged lifecycle the
+        pipelined pump drives directly: :meth:`stage_window` →
+        :meth:`dispatch_window` → :meth:`retire_window`.
         """
+        sw = self.stage_window(plan, feeds, max_iters)
+        if sw is None:
+            return None
+        out = self.dispatch_window(sw)
+        if out is None:
+            return None
+        self.retire_window(sw)
+        return out
+
+    def stage_window(self, plan, feeds, max_iters):
+        """Front half of the window lifecycle: validate the window fits
+        the fused path, slot-write every host batch into the ingress
+        queue's staging generation, and SEAL that generation (its buffers
+        now belong to the upcoming dispatch — the queue's next write
+        rotates onto a fresh set, so a pipelined caller can stage window
+        N+1 while N is in flight). Returns a :class:`StagedWindow` to
+        pass to :meth:`dispatch_window`, or None when the window doesn't
+        fit (same conditions as :meth:`run_window`; nothing is staged or
+        sealed in that case).
+
+        A successful stage GUARANTEES the dispatch can engage: for loop
+        graphs the fused fixpoint program (``call_many``) is built and
+        cache-checked here, so the caller may commit irreversible work
+        (WAL appends) between stage and dispatch without risking a
+        silent fallback in between."""
         if not self.supports_window():
             return None
         K = len(feeds)
@@ -539,6 +591,21 @@ class TpuExecutor(Executor):
             if rows > self.megatick_max_rows:
                 return None
             caps[nid] = bucket_capacity(rows)
+
+        if self.graph.loops:
+            # pre-build the fused fixpoint program NOW: dispatch must not
+            # be able to return None after the caller has WAL-logged the
+            # staged window (a post-stage fallback would double-append)
+            sig = ("fx", tuple(n.id for n in plan),
+                   tuple(sorted(caps.items())), max_iters)
+            prog = self._cache.get(sig)
+            if prog is None:
+                prog = self._build_fixpoint(plan, caps, max_iters)
+                if prog is None:
+                    return None
+                self._cache[sig] = prog
+            if not hasattr(prog, "call_many"):
+                return None
 
         qsig = ("ingress_q", tuple(n.id for n in plan),
                 tuple(sorted(caps.items())), K)
@@ -560,19 +627,50 @@ class TpuExecutor(Executor):
                 queue.write(t, nid, f[nid])
         if _trace.ENABLED:
             _trace.evt("queue_write", t_h0, time.perf_counter() - t_h0,
-                       args={"ticks": K, "slots": K * len(node_ids)})
+                       args={"ticks": K, "slots": K * len(node_ids),
+                             "inflight": queue.in_flight})
+        stack = queue.stacked()
+        gen = queue.seal()
+        return StagedWindow(plan, caps, K, max_iters, queue, gen, stack,
+                            qsig)
+
+    def dispatch_window(self, sw: "StagedWindow"):
+        """Middle of the window lifecycle: one device dispatch over the
+        staged stack (DONATED to the program). Stores the program's
+        returned zeroed pass-through stack on ``sw.fresh`` for
+        :meth:`retire_window` — the dispatch itself is async, so a
+        pipelined caller returns here while the device is still
+        executing and can immediately stage the next window."""
         try:
-            out = self._dispatch_many(plan, queue.stacked(), caps, K,
-                                      max_iters, window=True, queue=queue)
+            out = self._dispatch_many(sw.plan, sw.stack, sw.caps, sw.K,
+                                      sw.max_iters, window=True, staged=sw)
         except Exception:
             # the stack was DONATED: if the dispatch died mid-flight the
             # queue's buffers are gone — drop it so the next window
             # allocates fresh instead of writing into deleted arrays
-            self._cache.pop(qsig, None)
+            self._cache.pop(sw.qsig, None)
             raise
-        if out is not None:
-            self.window_dispatches += 1
+        if out is None:
+            # unreachable by construction (stage pre-builds the program);
+            # nothing was donated, so un-seal the generation
+            sw.queue.cancel(sw.gen)
+            return None
+        self.window_dispatches += 1
         return out
+
+    def retire_window(self, sw: "StagedWindow") -> None:
+        """Tail of the window lifecycle: hand the dispatched program's
+        fresh zeroed stack back to the ingress queue, re-asserting
+        placement and freeing the generation for restaging. Off the
+        critical path — a pipelined pump runs this after the NEXT window
+        is already in flight."""
+        sw.queue.retire(sw.gen, sw.fresh)
+        sw.fresh = None
+
+    def cancel_window(self, sw: "StagedWindow") -> None:
+        """Abandon a staged window whose dispatch never ran (nothing was
+        donated): the generation goes straight back to the free list."""
+        sw.queue.cancel(sw.gen)
 
     def _window_signature(self, plan, caps) -> Optional[tuple]:
         """Process-wide share key for a loop-free window program: the
@@ -590,18 +688,20 @@ class TpuExecutor(Executor):
                 tuple(sorted(caps.items())))
 
     def _dispatch_many(self, plan, stack, caps, K, max_iters, *,
-                       window: bool = False, queue=None):
+                       window: bool = False, staged=None):
         """Shared macro-tick dispatch tail: compile (or reuse) the K-tick
         scan program for ``plan``/``caps``, run it over the [K, C]
         ingress ``stack``, and return the scheduler-facing
         ``(passes_base, iters, rows, converged, extra_dirty)`` tuple
         (None when the fixpoint program lacks a fused ``call_many``).
-        The stack is DONATED to the program; when ``queue`` is given the
-        program's returned fresh (zeroed) stack is re-bound into it, so
-        the ingress queue and the window never hold two live copies.
-        ``window=True`` tags the dispatch span as the mega-tick path and
-        wraps it in a ``jax.profiler`` annotation so Perfetto lines host
-        stages up against device occupancy."""
+        The stack is DONATED to the program; when ``staged`` (a
+        :class:`StagedWindow`) is given, the program's returned fresh
+        (zeroed) stack is parked on it for the retire step instead of
+        being re-adopted inline — the queue and the window never hold
+        two live copies either way. ``window=True`` tags the dispatch
+        span as the mega-tick path and wraps it in a ``jax.profiler``
+        annotation so Perfetto lines host stages up against device
+        occupancy."""
         from reflow_tpu.utils.metrics import profile_annotation
 
         if not self.graph.loops:
@@ -651,8 +751,8 @@ class TpuExecutor(Executor):
             t_d0 = time.perf_counter() if _trace.ENABLED else 0.0
             with profile_annotation(f"reflow.window[{K}]", enabled=window):
                 self.states, fresh = prog(dict(self.states), stack)
-            if queue is not None:
-                queue.rebind(fresh)
+            if staged is not None:
+                staged.fresh = fresh
             if _trace.ENABLED:
                 _trace.evt("device_dispatch", t_d0,
                            time.perf_counter() - t_d0,
@@ -683,8 +783,8 @@ class TpuExecutor(Executor):
         with profile_annotation(f"reflow.window[{K}]", enabled=window):
             new_states, (iters, rows, conv), fresh = prog.call_many(
                 dict(self.states), stack, K)
-        if queue is not None:
-            queue.rebind(fresh)
+        if staged is not None:
+            staged.fresh = fresh
         if _trace.ENABLED:
             _trace.evt("device_dispatch", t_d0,
                        time.perf_counter() - t_d0,

@@ -182,6 +182,21 @@ class SourceCursor:
         return cls(source, top + 1)
 
 
+class _StagedTicks:
+    """Handle for one staged-but-undispatched fused window
+    (``stage_window`` → ``dispatch_staged`` → ``retire_staged``): the
+    executor's :class:`StagedWindow` plus the scheduler-side facts the
+    dispatch needs to build the aggregated TickResult."""
+
+    __slots__ = ("sw", "k", "host_rows", "plan")
+
+    def __init__(self, sw, k: int, host_rows: int, plan):
+        self.sw = sw
+        self.k = k
+        self.host_rows = host_rows
+        self.plan = plan
+
+
 class DirtyScheduler:
     def __init__(self, graph: FlowGraph, executor: Optional[Executor] = None,
                  *, max_loop_iters: int = 10_000,
@@ -573,6 +588,130 @@ class DirtyScheduler:
         else:
             self.megatick_windows += 1
         return fx
+
+    # -- staged (pipelined) window path ------------------------------------
+    #
+    # The serve pump's software-pipelined drive of the same mega-tick:
+    # stage_window (host slot writes + WAL append) can overlap a previous
+    # window's device execution; dispatch_staged commits the tick horizon
+    # and returns the TickResult; retire_staged re-adopts the donated
+    # buffers off the critical path. stage → dispatch → retire on one
+    # window is semantically identical to tick_many's fused branch.
+
+    def stage_window(self, feeds: Sequence[Dict[Node, DeltaBatch]], *,
+                     feed_ids: Optional[Sequence[Dict[Node, Sequence[str]]]]
+                     = None):
+        """Stage (but do not dispatch) one K-tick fused window: validate
+        and pad the feeds exactly as ``tick_many``'s window path does,
+        slot-write them into the executor's ingress queue, and seal the
+        staged generation. Returns an opaque handle for
+        :meth:`dispatch_staged` / :meth:`retire_staged`, or None when the
+        window doesn't fit the fused path — the caller then falls back to
+        :meth:`tick_many`, which re-checks and counts the fallback itself
+        (nothing is counted or logged here on refusal, so the fallback
+        isn't double-counted).
+
+        A successful stage has already WAL-logged the window's pushes
+        (append-before-dispatch, same order as ``tick_many``) and
+        registered its batch ids, so the caller MUST follow with
+        ``dispatch_staged`` — abandoning a staged window is a crash, not
+        a fallback."""
+        if any(self._pending.values()):
+            raise GraphError("stage_window cannot run with pending "
+                             "push()ed batches; tick() them first")
+        stage = getattr(self.executor, "stage_window", None)
+        if stage is None or not self.window_support or not feeds:
+            return None
+        nfeeds = []
+        for f in feeds:
+            entry = {}
+            for src, b in f.items():
+                if src.kind not in ("source", "loop"):
+                    raise GraphError(
+                        f"can only feed sources/loops, not {src}")
+                if hasattr(b, "nonzero"):
+                    return None  # device-resident: walpipe's own slot
+                entry[src.id] = b
+            nfeeds.append(entry)
+        K = len(nfeeds)
+        union = sorted({n for f in nfeeds for n in f})
+        if not union:
+            return None
+        pad_slots = sum(1 for f in nfeeds for nid in union
+                        if nid not in f or len(f[nid]) == 0)
+        if pad_slots / (K * len(union)) > self.megatick_waste:
+            return None
+        plan = self._dirty_plan(union)
+        padded = [dict(f) for f in nfeeds]
+        for f in padded:
+            for nid in union:
+                if nid not in f:
+                    f[nid] = self._zero_batch(nid)
+        sw = stage(plan, padded, self.max_loop_iters)
+        if sw is None:
+            return None
+        # the stage is committed: register ids and WAL-log the pushes NOW
+        # (append-before-dispatch). On the earlier refusals above nothing
+        # was registered, so the tick_many fallback re-registers cleanly
+        # (_register_batch_id tolerates replays).
+        if feed_ids is not None:
+            if len(feed_ids) != len(feeds):
+                raise GraphError(
+                    f"feed_ids must parallel feeds "
+                    f"({len(feed_ids)} != {len(feeds)})")
+            for ids_map in feed_ids:
+                for ids in ids_map.values():
+                    for bid in ids:
+                        self._register_batch_id(bid)
+        self._log_window_feeds(feeds, feed_ids)
+        host_rows = sum(len(b) for f in nfeeds for b in f.values())
+        return _StagedTicks(sw, K, host_rows, plan)
+
+    def _log_window_feeds(self, feeds, feed_ids) -> None:
+        """Durability hook for a successfully staged window: the base
+        scheduler has no log; ``DurableScheduler`` appends the window's
+        push records here (append-before-dispatch)."""
+
+    def dispatch_staged(self, handle: "_StagedTicks") -> TickResult:
+        """Dispatch a staged window: ONE device execution, the tick
+        horizon advances by K, and the aggregated TickResult (identical
+        to ``tick_many``'s fused branch) is returned. The dispatch is
+        async — the caller can stage the next window immediately and
+        ``retire_staged`` this one later."""
+        t0 = time.perf_counter()
+        fx = self.executor.dispatch_window(handle.sw)
+        if fx is None:
+            # stage_window guaranteed the fused program exists — a None
+            # here is a lifecycle bug, and the window's WAL records are
+            # already appended, so falling back would double-log
+            raise GraphError("staged window refused dispatch")
+        self.megatick_windows += 1
+        passes_base, iters, rows, conv, extra_dirty = fx
+        K = handle.k
+        plan_ids = {n.id for n in handle.plan}
+        self._tick += K
+        result = TickResult(
+            tick=self._tick,
+            sink_deltas={},
+            passes=LazyScalar(passes_base, iters),
+            dirty_nodes=len(plan_ids | extra_dirty),
+            deltas_in=LazyScalar(handle.host_rows, rows),
+            deltas_out=0,
+            wall_s=time.perf_counter() - t0,
+            quiesced=conv,
+            _check_errors=self.executor.check_errors,
+        )
+        if _trace.ENABLED:
+            _trace.evt("tick_many", t0, result.wall_s,
+                       args={"ticks": K, "fused": True, "staged": True})
+        self.history.append(result)
+        return result
+
+    def retire_staged(self, handle: "_StagedTicks") -> None:
+        """Settle a dispatched window off the critical path: hand the
+        window program's returned zeroed stack back to the ingress queue
+        (placement re-assertion included) and free its generation."""
+        self.executor.retire_window(handle.sw)
 
     def publish_metrics(self, registry=None, *, name: Optional[str]
                         = None) -> str:

@@ -95,10 +95,13 @@ class GraphConfig:
     #: None | jax.Device | int index into jax.devices() (implies "pin")
     device: Optional[object] = None
     placement: str = "none"  # "none" | "spread" | "pin"
+    #: pipelined window depth for this graph's pump (None = the
+    #: frontend's REFLOW_WINDOW_DEPTH default; 1 = serial windows)
+    window_depth: Optional[int] = None
 
 
-def dwrr_pick(ready: List["GraphHandle"],
-              quantum_rows: int) -> "GraphHandle":
+def dwrr_pick(ready: List["GraphHandle"], quantum_rows: int,
+              busy_devices: frozenset = frozenset()) -> "GraphHandle":
     """Deficit-weighted round-robin over the ready graphs.
 
     Each graph carries a rolling deficit in row units. When every ready
@@ -109,12 +112,25 @@ def dwrr_pick(ready: List["GraphHandle"],
     therefore proportional to weight, independent of burst shape; a
     graph that is rarely ready is never replenished in absentia, so it
     cannot hoard deficit and then monopolize the pool.
+
+    ``busy_devices`` makes the pick placement-aware: among the
+    positive-deficit candidates, graphs whose bound device currently
+    has NO window in flight are preferred (largest deficit among them),
+    so co-located tenants stop contending for a chip while other chips
+    idle. Deficit accounting is untouched — a deferred graph keeps its
+    deficit and wins as soon as its device frees up, so long-run
+    weighted fairness is preserved; only the service ORDER shifts. When
+    every candidate's device is busy (or devices are untagged) the pick
+    falls back to pure DWRR.
     """
     while all(h._deficit <= 0 for h in ready):
         for h in ready:
             h._deficit += h.config.weight * quantum_rows
-    return max((h for h in ready if h._deficit > 0),
-               key=lambda h: h._deficit)
+    cands = [h for h in ready if h._deficit > 0]
+    free = [h for h in cands
+            if h.device_label is None
+            or h.device_label not in busy_devices]
+    return max(free or cands, key=lambda h: h._deficit)
 
 
 class GraphHandle:
@@ -150,7 +166,8 @@ class GraphHandle:
         """Where this graph's windows execute: the executor's obs tag
         (``"cpu:3"`` for a pinned tenant, ``"mesh[8]"`` for a sharded
         one, None on the default device)."""
-        return getattr(getattr(self.frontend.sched, "executor", None),
+        sched = getattr(self.frontend, "sched", None)
+        return getattr(getattr(sched, "executor", None),
                        "device_label", None)
 
     def submit(self, source, batch, **kw):
@@ -197,6 +214,12 @@ class ServeTier:
         # -- counters (utils.metrics.summarize_tier) --
         self.windows = 0
         self.pool_crashes = 0
+        #: picks whose graph's device already had a window in flight —
+        #: the placement-aware DWRR tie-break could not avoid the
+        #: contention (every positive-deficit candidate was co-located
+        #: with busy hardware). trace_inspect's per-device breakdown
+        #: shows the resulting skew.
+        self.device_collisions = 0
         self._busy_s = 0.0
         self._metric_keys: List = []
         self._t0 = time.perf_counter()
@@ -270,7 +293,7 @@ class ServeTier:
                     else self._crash,
                     start=False, budget=share, lock=self._lock,
                     work=self._work, name=name,
-                    admission=cfg.admission)
+                    admission=cfg.admission, depth=cfg.window_depth)
             except BaseException:
                 self.budget.unregister(name)
                 raise
@@ -364,6 +387,8 @@ class ServeTier:
                   lambda: self.budget.used / self.budget.total_bytes)
         reg.gauge(f"{name}.live_workers", lambda: self.live_workers)
         reg.gauge(f"{name}.worker_deaths", lambda: self.worker_deaths)
+        reg.gauge(f"{name}.device_collisions",
+                  lambda: self.device_collisions)
         self._metric_keys.append((reg, name))
         return name
 
@@ -462,9 +487,11 @@ class ServeTier:
                 self._work.notify_all()
 
     def _pool_iteration(self) -> bool:
-        # one pick + macro-tick; False = exit this worker (close/retire)
+        # one pick + macro-tick (or one settle-only pass over a graph
+        # with retired work pending); False = exit this worker
         with self._lock:
             picked = None
+            settle_h: Optional[GraphHandle] = None
             while picked is None:
                 if self._closed:
                     return False
@@ -488,7 +515,18 @@ class ServeTier:
                             wait_t = (w if wait_t is None
                                       else min(wait_t, w))
                 if ready:
-                    picked = dwrr_pick(ready, self.quantum_rows)
+                    # placement-aware tie-break: devices with a window
+                    # (or unretired pipeline) in flight are "busy" —
+                    # prefer candidates whose chip is idle
+                    busy = frozenset(
+                        h.device_label for h in self._graphs.values()
+                        if h.device_label is not None
+                        and (h.frontend._executing
+                             or h.frontend._inflight))
+                    picked = dwrr_pick(ready, self.quantum_rows, busy)
+                    if (picked.device_label is not None
+                            and picked.device_label in busy):
+                        self.device_collisions += 1
                     ready_since = picked._ready_since
                     picked.sched_delay_s.append(now - ready_since)
                     picked._ready_since = None
@@ -500,7 +538,34 @@ class ServeTier:
                     drained = picked.frontend._take_window(
                         ready_since=ready_since)
                 else:
+                    # nothing fireable: retire any graph's dispatched-
+                    # but-unsettled pipelined windows (their tickets
+                    # wire to the durable watermark here, and pause/
+                    # close waiters unblock)
+                    settle_h = next(
+                        (h for h in self._graphs.values()
+                         if h.frontend._needs_settle()), None)
+                    if settle_h is not None:
+                        settle_h.frontend._begin_settle()
+                        break
                     self._work.wait(timeout=wait_t)
+        if settle_h is not None:
+            t0 = time.perf_counter()
+            crashed = False
+            try:
+                settle_h.frontend._settle_all()
+            except BaseException as e:  # noqa: BLE001 - fault isolation
+                crashed = True
+                settle_h.frontend._on_pump_crash(e)
+            with self._lock:
+                self._busy_s += time.perf_counter() - t0
+                if crashed:
+                    self.pool_crashes += 1
+                    settle_h.crashes += 1
+                else:
+                    settle_h.frontend._finish_window()
+                self._work.notify_all()
+            return True
         # -- macro-tick, unlocked (single-owner: the latch set by
         # _take_window keeps every other worker off this graph) --
         t0 = time.perf_counter()

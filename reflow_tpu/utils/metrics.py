@@ -198,6 +198,15 @@ class ServeMetrics:
     admission_p95_s: float
     queue_depth_p95: float
     inflight_bytes_peak: int
+    #: pipelined-pump view: configured in-flight window depth, windows
+    #: that took the stage/dispatch/retire path, how many of those
+    #: staged while a previous window was still in flight, and the
+    #: fraction of host staging wall that overlapped device compute
+    #: (0.0 at depth 1 — staging and execution strictly alternate)
+    window_depth: int = 1
+    windows_staged: int = 0
+    windows_pipelined: int = 0
+    stage_overlap_frac: float = 0.0
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -228,6 +237,10 @@ def summarize_serve(frontend) -> ServeMetrics:
         admission_p95_s=pct(frontend.admission_s, 95),
         queue_depth_p95=pct(frontend.queue_depth_samples, 95),
         inflight_bytes_peak=frontend.inflight_bytes_peak,
+        window_depth=getattr(frontend, "depth", 1),
+        windows_staged=getattr(frontend, "windows_staged", 0),
+        windows_pipelined=getattr(frontend, "windows_pipelined", 0),
+        stage_overlap_frac=getattr(frontend, "stage_overlap_frac", 0.0),
     )
 
 
@@ -263,6 +276,10 @@ class TierMetrics:
     live_workers: int = 0
     worker_deaths: int = 0
     worker_respawns: int = 0
+    #: picks where every positive-deficit candidate's bound device
+    #: already had a window in flight (placement-aware DWRR could not
+    #: avoid stacking; persistent growth = graphs-per-device skew)
+    device_collisions: int = 0
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -321,6 +338,7 @@ def summarize_tier(tier) -> TierMetrics:
         live_workers=tier.live_workers,
         worker_deaths=tier.worker_deaths,
         worker_respawns=tier.worker_respawns,
+        device_collisions=tier.device_collisions,
     )
 
 

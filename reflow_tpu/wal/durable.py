@@ -205,6 +205,37 @@ class DurableScheduler(DirtyScheduler):
         # commit), not one per micro-batch. Device-resident feeds log
         # their registered pre-image (no readback); only an unregistered
         # device feed pays the forced materialize.
+        logged, records = self._window_records(feeds, feed_ids)
+        self._crash_point("before_append")
+        # request=False: the window is ONE logical commit — the marker
+        # group below carries the single durability barrier covering
+        # data + markers (acknowledgement gates on the marker LSN)
+        self.wal.append_group(records, wait=False, request=False)
+        self._crash_point("after_append")
+        # suspend the per-tick overrides during execution: the fallback
+        # path runs self.tick() per feed, and its per-tick markers would
+        # duplicate the window markers appended below
+        self._wal_suspended = True
+        try:
+            result = super().tick_many(logged, feed_ids=feed_ids)
+        finally:
+            self._wal_suspended = False
+        tick_now = self._tick
+        self.wal.append_group([
+            {"kind": "tick", "tick": t}
+            for t in range(tick_now - len(feeds) + 1, tick_now + 1)],
+            wait=False)
+        self.wal.note_tick(wait=False)
+        if wait_durable:
+            self.wal.wait_durable(self.wal.last_lsn())
+        self._crash_point("after_tick")
+        return result
+
+    def _window_records(self, feeds, feed_ids):
+        """Build one window's WAL push records (and the executable feed
+        maps with device batches swapped for their logged host images).
+        Shared between ``tick_many`` and the staged pipeline's
+        ``_log_window_feeds``."""
         ids_seq = feed_ids if feed_ids is not None else [{}] * len(feeds)
         logged, records = [], []
         for feed, ids_map in zip(feeds, ids_seq):
@@ -229,29 +260,39 @@ class DurableScheduler(DirtyScheduler):
                     rec["batch_ids"] = ids
                 records.append(rec)
             logged.append(entry)
+        return logged, records
+
+    # -- staged (pipelined) windows ----------------------------------------
+
+    def _log_window_feeds(self, feeds, feed_ids) -> None:
+        """Append a staged window's push records before its dispatch —
+        the same append-before-dispatch order, grouping, and single
+        durability barrier as ``tick_many`` (request=False here; the
+        marker group appended by ``dispatch_staged`` carries the
+        window's one durability request). ``stage_window`` rejects
+        device-resident feeds before reaching this, so no materialize
+        readbacks can occur here."""
+        if self._wal_suspended:
+            return
+        _, records = self._window_records(feeds, feed_ids)
         self._crash_point("before_append")
-        # request=False: the window is ONE logical commit — the marker
-        # group below carries the single durability barrier covering
-        # data + markers (acknowledgement gates on the marker LSN)
         self.wal.append_group(records, wait=False, request=False)
         self._crash_point("after_append")
-        # suspend the per-tick overrides during execution: the fallback
-        # path runs self.tick() per feed, and its per-tick markers would
-        # duplicate the window markers appended below
-        self._wal_suspended = True
-        try:
-            result = super().tick_many(logged, feed_ids=feed_ids)
-        finally:
-            self._wal_suspended = False
-        tick_now = self._tick
-        self.wal.append_group([
-            {"kind": "tick", "tick": t}
-            for t in range(tick_now - len(feeds) + 1, tick_now + 1)],
-            wait=False)
-        self.wal.note_tick(wait=False)
-        if wait_durable:
-            self.wal.wait_durable(self.wal.last_lsn())
-        self._crash_point("after_tick")
+
+    def dispatch_staged(self, handle):
+        """Dispatch a staged window and append its K tick markers. Never
+        blocks on the fsync (the pipelined-commit contract): the caller
+        gates acknowledgements on ``wal.when_durable(wal.last_lsn(), …)``
+        read right after this returns."""
+        result = super().dispatch_staged(handle)
+        if not self._wal_suspended:
+            tick_now = self._tick
+            self.wal.append_group([
+                {"kind": "tick", "tick": t}
+                for t in range(tick_now - handle.k + 1, tick_now + 1)],
+                wait=False)
+            self.wal.note_tick(wait=False)
+            self._crash_point("after_tick")
         return result
 
     def close(self) -> None:

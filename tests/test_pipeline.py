@@ -1,0 +1,339 @@
+"""Pipelined window execution (docs/guide.md "Pipelined windows").
+
+The contract under test: splitting the fused window lifecycle into
+stage → dispatch → retire with a bounded in-flight depth changes WHEN
+work happens, never WHAT is computed —
+
+- depth 2/4 drives through an ``IngestFrontend`` produce tables EXACTLY
+  equal (bitwise) to the depth-1 drive on identical batches, and both
+  match the per-tick CPU oracle;
+- staging window N+1 never writes a buffer set an in-flight window
+  program owns (generation rotation), including when the pump crashes
+  with windows dispatched but unretired — every ticket still resolves;
+- a producer blocked on the admission budget wakes at STAGE-complete
+  (the chunk's rows live in the device queue, their host bytes no
+  longer occupy the frontend), not at retire;
+- the ingress queue refuses int64 keys outside the int32 slot range
+  instead of silently wrapping them.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from reflow_tpu import DirtyScheduler, FlowGraph
+from reflow_tpu.delta import DeltaBatch, Spec
+from reflow_tpu.executors import get_executor
+from reflow_tpu.executors.device_delta import DeviceDelta
+from reflow_tpu.executors.ingress_queue import DeviceIngressQueue, slot_nbytes
+from reflow_tpu.serve import CoalesceWindow, IngestFrontend, PumpCrashed
+from reflow_tpu.utils.faults import CrashInjector, DeliveryError
+
+K_SPACE = 32
+ROWS = 6
+
+
+def _batch(rows):
+    return DeltaBatch(np.array([r[0] for r in rows], np.int64),
+                      np.array([r[1] for r in rows], np.float32),
+                      np.array([r[2] for r in rows], np.int64))
+
+
+def _graph():
+    """source -> map -> reduce(sum): loop-free, sink-free, ONE source so
+    every feed is uniform and the fused window path always engages."""
+    g = FlowGraph("pipeline")
+    spec = Spec((), np.float32, key_space=K_SPACE)
+    s = g.source("s", spec)
+    m = g.map(s, lambda v: v * np.float32(2), vectorized=True)
+    r = g.reduce(m, "sum", tol=0.0)
+    return g, s, r
+
+
+def _mk_batches(seed, n=8, rows=ROWS):
+    rng = np.random.default_rng(seed)
+    return [_batch([(int(rng.integers(0, K_SPACE)),
+                     float(rng.integers(0, 8)), 1) for _ in range(rows)])
+            for _ in range(n)]
+
+
+def _table(sched, node, nd=None):
+    return {int(k): (float(np.asarray(v).reshape(()))
+                     if nd is None
+                     else round(float(np.asarray(v).reshape(())), nd))
+            for k, v in sched.read_table(node).items()}
+
+
+def _oracle(batches):
+    g, s, r = _graph()
+    sched = DirtyScheduler(g, get_executor("cpu"))
+    for b in batches:
+        sched.push(s, b)
+        sched.tick()
+    return _table(sched, r, nd=3)
+
+
+def _frontend_drive(batches, depth, k):
+    """One paused wave through a frontend pump: all batches queue, then
+    resume drains them as one multi-chunk backlog (chunks of ``k``
+    ticks), which is what makes consecutive windows actually pipeline
+    at depth > 1. Returns (exact table, sched, frontend)."""
+    g, s, r = _graph()
+    sched = DirtyScheduler(g, get_executor("tpu"))
+    fe = IngestFrontend(sched, depth=depth, window=CoalesceWindow(
+        max_rows=ROWS, max_ticks=k, max_latency_s=0.001))
+    try:
+        fe.pause()
+        tks = [fe.submit(s, b) for b in batches]
+        fe.resume()
+        fe.flush(timeout=30)
+        assert all(t.result(timeout=10).applied for t in tks)
+    finally:
+        fe.close()
+    return _table(sched, r), sched, fe
+
+
+def _queue(sched) -> DeviceIngressQueue:
+    qkeys = [key for key in sched.executor._cache
+             if isinstance(key, tuple) and key and key[0] == "ingress_q"]
+    assert len(qkeys) == 1
+    return sched.executor._cache[qkeys[0]]
+
+
+# -- differential fuzz: depths x window sizes x seeds ----------------------
+
+@pytest.mark.parametrize("k", [2, 4])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_depth_fuzz_parity(seed, k):
+    """Depth 2 and 4 are bit-for-bit depth 1 (same fused program, same
+    slot contents, same dispatch order), and all match the oracle."""
+    batches = _mk_batches(seed)
+    want = _oracle(batches)
+    t1, s1, fe1 = _frontend_drive(batches, depth=1, k=k)
+    t2, s2, fe2 = _frontend_drive(batches, depth=2, k=k)
+    t4, s4, fe4 = _frontend_drive(batches, depth=4, k=k)
+    assert t2 == t1 and t4 == t1          # EXACT float equality
+    assert {key: round(v, 3) for key, v in t1.items()} == want
+    for sched in (s1, s2, s4):
+        assert sched.megatick_fallbacks == 0
+        assert sched.megatick_windows == len(batches) // k
+    # depth 1 is literally the serial tick_many path; deeper drives
+    # stage every chunk and overlap all but each wave's first
+    assert fe1.windows_staged == 0 and fe1.stage_overlap_frac == 0.0
+    for fe in (fe2, fe4):
+        assert fe.windows_staged == len(batches) // k
+        assert fe.windows_pipelined >= 1
+        assert fe.stage_overlap_frac > 0.0
+
+
+# -- stage never touches an in-flight generation ---------------------------
+
+def test_stage_rotates_off_inflight_generation():
+    """While window A is dispatched-but-unretired, staging window B
+    lands in a DIFFERENT buffer generation: no array object of A's
+    donated stack is reused, so B's slot writes can't corrupt A."""
+    g, s, red = _graph()
+    sched = DirtyScheduler(g, get_executor("tpu"))
+    waves = [_mk_batches(5, n=2), _mk_batches(6, n=2)]
+
+    h1 = sched.stage_window([{s: b} for b in waves[0]])
+    assert h1 is not None
+    bufs1 = {id(arr) for dd in h1.sw.stack.values()
+             for arr in (dd.keys, dd.values, dd.weights)}
+    sched.dispatch_staged(h1)
+    q = _queue(sched)
+    assert q.in_flight == 1
+
+    h2 = sched.stage_window([{s: b} for b in waves[1]])
+    assert h2 is not None
+    assert h2.sw.gen != h1.sw.gen
+    bufs2 = {id(arr) for dd in h2.sw.stack.values()
+             for arr in (dd.keys, dd.values, dd.weights)}
+    assert not (bufs1 & bufs2)
+    assert q.generations == 2
+    sched.dispatch_staged(h2)
+    assert q.in_flight == 2
+
+    sched.retire_staged(h1)
+    sched.retire_staged(h2)
+    assert q.in_flight == 0
+    assert sched.megatick_fallbacks == 0
+    # both windows' rows landed: views equal the per-tick oracle
+    g2, s2, r2 = _graph()
+    per = DirtyScheduler(g2, get_executor("cpu"))
+    for b in waves[0] + waves[1]:
+        per.push(s2, b)
+        per.tick()
+    assert _table(sched, red, nd=3) == _table(per, r2, nd=3)
+
+
+def test_depth1_pingpong_reuses_generation_zero():
+    """The serial flow (seal -> dispatch -> retire -> seal) never
+    allocates a second generation — same memory footprint as before
+    pipelining."""
+    g, s, _r = _graph()
+    sched = DirtyScheduler(g, get_executor("tpu"))
+    for seed in (7, 8, 9):
+        res = sched.tick_many([{s: b} for b in _mk_batches(seed, n=2)])
+        res.block()
+    q = _queue(sched)
+    assert sched.megatick_windows == 3
+    assert q.generations == 1
+    assert q.in_flight == 0
+
+
+def test_crash_with_window_in_flight_fails_every_ticket():
+    """Kill the pump between chunk dispatches (chunk 1 dispatched and
+    unretired, chunk 2 about to stage): the crash path must fail BOTH
+    chunks' tickets — the in-flight window's ids stay in the dedup
+    mirror, so a replay after recovery dedups instead of double-folding."""
+    g, s, _r = _graph()
+    sched = DirtyScheduler(g, get_executor("tpu"))
+    crash = CrashInjector(2, only="pump_before_tick")
+    fe = IngestFrontend(sched, crash=crash, depth=2,
+                        window=CoalesceWindow(max_rows=ROWS, max_ticks=2,
+                                              max_latency_s=0.001))
+    fe.pause()
+    tks = [fe.submit(s, b, batch_id=f"b{i}")
+           for i, b in enumerate(_mk_batches(3, n=4))]
+    fe.resume()
+    for t in tks:
+        with pytest.raises(PumpCrashed):
+            t.result(timeout=10)
+    assert crash.fired
+    assert not fe._inflight
+    assert fe._pending_res == 0
+    # executed-but-unresolved ids stay admitted: a resend dedups
+    assert "b0" in fe._admitted and "b3" in fe._admitted
+    fe.close()
+
+
+# -- stage-complete budget release -----------------------------------------
+
+def test_stage_release_unblocks_producer_before_retire():
+    """A budget-blocked producer wakes when the current chunk finishes
+    STAGING (its rows now live in the device queue), not when the window
+    retires — the regression for release-at-stage-complete. Settling is
+    stubbed out, so only the stage-complete release can unblock it."""
+    g, s, _r = _graph()
+    sched = DirtyScheduler(g, get_executor("tpu"))
+    rows = 4
+    fe = IngestFrontend(sched, start=False, depth=2, policy="block",
+                        max_bytes=slot_nbytes(s.spec, rows),
+                        window=CoalesceWindow(max_rows=rows, max_ticks=2,
+                                              max_latency_s=0.001))
+    mk = lambda v: _batch([(i, float(v), 1) for i in range(rows)])
+    t1 = fe.submit(s, mk(1))
+    admitted = threading.Event()
+    t2_box = []
+
+    def produce():
+        t2_box.append(fe.submit(s, mk(2)))
+        admitted.set()
+
+    th = threading.Thread(target=produce, daemon=True)
+    th.start()
+    time.sleep(0.05)
+    assert not admitted.is_set()       # genuinely blocked on the budget
+    real_settle = fe._settle_all
+    fe._settle_all = lambda: None
+    try:
+        with fe._lock:
+            drained = fe._take_window()
+        fe._run_window(drained)
+        assert fe._inflight            # dispatched, NOT retired
+        assert admitted.wait(5), ("producer still blocked after "
+                                  "stage-complete")
+    finally:
+        fe._settle_all = real_settle
+    fe._settle_all()
+    with fe._lock:
+        fe._finish_window()
+    with fe._lock:
+        drained = fe._take_window()
+    fe._run_window(drained)
+    with fe._lock:
+        fe._finish_window()
+    th.join(timeout=5)
+    assert t1.result(timeout=5).applied
+    assert t2_box[0].result(timeout=5).applied
+    fe.close()
+
+
+# -- ingress queue: generation rotation + key-range guard ------------------
+
+def _unit_queue(k=2, cap=4, key_space=8):
+    spec = Spec((), np.float32, key_space=key_space)
+    return DeviceIngressQueue({0: spec}, {0: cap}, k), spec
+
+
+def _fresh_stack(k, cap):
+    import jax.numpy as jnp
+
+    return {0: DeviceDelta(jnp.zeros((k, cap), jnp.int32),
+                           jnp.zeros((k, cap), jnp.float32),
+                           jnp.zeros((k, cap), jnp.int32))}
+
+
+def test_seal_rotates_and_retire_frees():
+    q, _spec = _unit_queue()
+    q.write(0, 0, _batch([(1, 2.0, 1)]))
+    st1 = q.stacked()
+    g0 = q.seal()
+    assert q.in_flight == 1
+    q.write(0, 0, _batch([(2, 3.0, 1)]))   # rotates onto a fresh gen
+    st2 = q.stacked()
+    assert q.generations == 2
+    assert {id(a) for dd in st1.values()
+            for a in (dd.keys, dd.values, dd.weights)}.isdisjoint(
+        {id(a) for dd in st2.values()
+         for a in (dd.keys, dd.values, dd.weights)})
+    # the sealed gen's contents are untouched by the new gen's writes
+    assert int(np.asarray(st1[0].weights[0]).sum()) == 1
+    q.retire(g0, _fresh_stack(2, 4))
+    assert q.in_flight == 0
+    with pytest.raises(ValueError):
+        q.retire(g0, _fresh_stack(2, 4))   # no longer in flight
+    with pytest.raises(ValueError):
+        q.retire(99, _fresh_stack(2, 4))
+
+
+def test_retire_validates_stack_keys():
+    q, _spec = _unit_queue()
+    q.write(0, 0, _batch([(1, 1.0, 1)]))
+    g0 = q.seal()
+    with pytest.raises(ValueError):
+        q.retire(g0, {5: _fresh_stack(2, 4)[0]})
+
+
+def test_cancel_returns_generation_without_adoption():
+    q, _spec = _unit_queue()
+    q.write(0, 0, _batch([(1, 1.0, 1)]))
+    g0 = q.seal()
+    q.cancel(g0)
+    assert q.in_flight == 0
+    q.write(1, 0, _batch([(2, 1.0, 1)]))   # reuses g0: no new allocation
+    assert q.generations == 1
+    assert q._staging == g0
+
+
+def test_rebind_requires_inflight_generation():
+    q, _spec = _unit_queue()
+    with pytest.raises(ValueError):
+        q.rebind(_fresh_stack(2, 4))
+
+
+def test_int64_keys_beyond_int32_rejected():
+    """Keys >= 2^31 used to be silently truncated by the int32 slot
+    assignment (wrapping to a DIFFERENT key and corrupting the fold);
+    now the host boundary refuses them."""
+    q, _spec = _unit_queue(key_space=2 ** 40)
+    with pytest.raises(DeliveryError):
+        q.write(0, 0, _batch([(2 ** 31, 1.0, 1)]))
+    with pytest.raises(DeliveryError):
+        q.write(0, 0, _batch([(-2 ** 31 - 1, 1.0, 1)]))
+    # boundary values are fine
+    q.write(0, 0, _batch([(2 ** 31 - 1, 1.0, 1)]))
+    assert q.writes == 1

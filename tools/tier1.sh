@@ -25,11 +25,11 @@ rc=${PIPESTATUS[0]}
 dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 echo DOTS_PASSED=$dots
 
-# regression floor: the suite passed 333 at the PR-7 baseline (315 at
+# regression floor: the suite passed 333 at the PR-8 baseline (315 at
 # PR 6, 278 at PR 5); a run below the previous baseline means
 # previously-green tests broke (or silently vanished), even if pytest's
 # own exit status reads clean.
-FLOOR=${TIER1_FLOOR:-315}
+FLOOR=${TIER1_FLOOR:-333}
 if [ "$dots" -lt "$FLOOR" ]; then
   echo "TIER1: DOTS_PASSED=$dots below floor $FLOOR"
   rc=4
@@ -156,6 +156,29 @@ print(f"TIER1 megatick smoke: tick_s_amortized {r['tick_s_amortized']}s "
       f"vs window_dispatch_s {r['window_dispatch_s']}s "
       f"({r['amortized_over_dispatch_x']}x), "
       f"{r['megatick_windows']} fused windows, views match")
+EOF
+fi
+
+# optional (RUN_BENCH=1): the pipeline smoke — pipelined window
+# execution: depth 2 must produce tables EXACTLY equal to depth 1 (same
+# fused program, same slots, same order — bitwise), never fall back to
+# per-tick, genuinely overlap host staging with in-flight dispatch
+# (stage_overlap_frac > 0), and pay no amortized-tick throughput tax.
+if [ "${RUN_BENCH:-0}" = "1" ] && [ $rc -eq 0 ]; then
+  REFLOW_BENCH_PIPELINE=1 REFLOW_BENCH_SMOKE=1 JAX_PLATFORMS=cpu \
+    timeout -k 10 300 python bench.py > /tmp/_t1_pipeline.json || rc=3
+  python - <<'EOF' || rc=3
+import json
+r = json.load(open("/tmp/_t1_pipeline.json"))
+assert r["views_match"] and r["max_abs_diff"] == 0.0, r
+assert r["twin_views_match"], r
+assert r["zero_fallbacks"], r
+assert r["overlap_at_depth2"], r
+assert r["depth2_not_slower"], r
+print(f"TIER1 pipeline smoke: depth2 {r['depth2_tick_s_amortized']}s/tick "
+      f"vs depth1 {r['depth1_tick_s_amortized']}s/tick "
+      f"({r['depth2_vs_depth1_x']}x), overlap "
+      f"{100 * r['depth2_stage_overlap_frac']:.0f}%, parity exact")
 EOF
 fi
 

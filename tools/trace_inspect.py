@@ -72,6 +72,13 @@ def inspect(path: str) -> dict:
     # stamps onto dispatch-side spans ("(default)" = untagged executor)
     dev_busy: dict = defaultdict(float)
     dev_dispatches: dict = defaultdict(int)
+    # pipelined staging: window_stage spans carry args.inflight (windows
+    # already dispatched when this stage began) — inflight > 0 means the
+    # host staging wall overlapped device compute
+    stage_total, stage_overlapped = 0.0, 0.0
+    # pump_execute spans carry args.depth (in-flight windows INCLUDING
+    # the one being dispatched) — the occupancy histogram of the pipeline
+    depth_counts: dict = defaultdict(int)
     for ev in events:
         if ev.get("ph") == "X":
             by_name[ev.get("name", "?")].append(float(ev.get("dur", 0.0)))
@@ -80,6 +87,15 @@ def inspect(path: str) -> dict:
                 dev = (ev.get("args") or {}).get("device") or "(default)"
                 dev_busy[dev] += float(ev.get("dur", 0.0))
                 dev_dispatches[dev] += 1
+            if ev.get("name") == "window_stage":
+                dur = float(ev.get("dur", 0.0))
+                stage_total += dur
+                if int((ev.get("args") or {}).get("inflight", 0) or 0) > 0:
+                    stage_overlapped += dur
+            if ev.get("name") == "pump_execute":
+                d = (ev.get("args") or {}).get("depth")
+                if d is not None:
+                    depth_counts[int(d)] += 1
             if ev.get("name") == "wal_fsync":
                 dur = float(ev.get("dur", 0.0))
                 if tid_names.get(ev.get("tid")) == "wal-committer":
@@ -146,6 +162,9 @@ def inspect(path: str) -> dict:
               "busy_ms": round(busy / 1e3, 3),
               "share": round(busy / dev_total, 4) if dev_total else 0.0}
         for dev, busy in sorted(dev_busy.items())}
+    stage_overlap_frac = (round(stage_overlapped / stage_total, 4)
+                          if stage_total else 0.0)
+    dispatch_by_depth = {str(d): n for d, n in sorted(depth_counts.items())}
     return {
         "schema": "reflow.trace_inspect/1",
         "trace_file": path,
@@ -153,6 +172,8 @@ def inspect(path: str) -> dict:
         "tracks": len(tracks),
         "durability": durability,
         "window_dispatch_frac": window_dispatch_frac,
+        "stage_overlap_frac": stage_overlap_frac,
+        "dispatch_by_depth": dispatch_by_depth,
         "per_device": per_device,
         "control_actions": control_actions,
         "spans": spans,
@@ -183,6 +204,13 @@ def _print_human(s: dict) -> None:
         print(f"window dispatch fraction: "
               f"{s['window_dispatch_frac']:.0%} of commit-window time "
               f"was device dispatch")
+    if s.get("stage_overlap_frac"):
+        print(f"stage overlap: {s['stage_overlap_frac']:.0%} of host "
+              f"staging time ran while a window was in flight")
+    if s.get("dispatch_by_depth"):
+        occ = ", ".join(f"depth {d}: {n}"
+                        for d, n in s["dispatch_by_depth"].items())
+        print(f"dispatch occupancy: {occ}")
     if s.get("per_device"):
         print(f"{'device':<12} {'dispatches':>11} {'busy_ms':>10} "
               f"{'share':>8}")
