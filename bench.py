@@ -119,6 +119,15 @@ Env knobs::
                                   stamped tickets, and inline==pipelined
                                   ==replayed sink views (CPU-only)
     REFLOW_BENCH_WALPIPE_BATCHES  batches per producer at 16p (default 4)
+    REFLOW_BENCH_REPLICA=1        read-replica mode instead: WAL shipping
+                                  to N ReplicaSchedulers under sustained
+                                  16-producer writes; aggregate ReadTier
+                                  top-k QPS vs the single-leader
+                                  baseline, bounded replay lag, and
+                                  exact leader-vs-replica view parity at
+                                  the published horizon (CPU-only)
+    REFLOW_BENCH_REPLICA_N        follower count            (default 4)
+    REFLOW_BENCH_REPLICA_READ_S   per-leg read window (s)   (default 2.0)
     REFLOW_TRACE_OUT              obs-mode chrome trace path
                                   (default /tmp/reflow_obs_trace.json)
 
@@ -1054,6 +1063,233 @@ def run_walpipe_bench() -> dict:
         log(f"walpipe[replay]: {report.replayed_pushes} pushes, "
             f"matches={out['replay_view_matches']}")
     finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+# -- WAL shipping / read-replica mode (REFLOW_BENCH_REPLICA=1) -------------
+
+def run_replica_bench() -> dict:
+    """Read-replica scaling (docs/guide.md "Read replicas"): a
+    wordcount leader (``DurableScheduler`` + ``IngestFrontend``) under
+    sustained 16-producer writes, with a ``SegmentShipper`` streaming
+    its synced WAL prefix to N ``ReplicaScheduler`` followers and a
+    ``ReadTier`` fanning top-k reads across them.
+
+    Two read legs run back to back under the SAME write load:
+
+    - **leader baseline**: 4 reader threads on the
+      ``LeaderReadAdapter`` — every read copies the live, mutable sink
+      view under one lock (the leader's views have no other consistent
+      read point), then ranks in Python;
+    - **replica aggregate**: the same 4 reader threads through the
+      ``ReadTier`` — each replica serves immutable per-horizon snapshot
+      arrays, so the hot path is a lock-free ``np.argpartition``.
+
+    Property checks ride along:
+
+    - **exact parity** — after quiesce (flush + sync + catch-up) every
+      replica's view at the published horizon equals the leader's with
+      ``max_abs_diff == 0`` (replicas replay the same WAL bytes through
+      the same idempotent machinery; there is nothing to be off by);
+    - **bounded lag** — final replica lag is 0 ticks and never exceeded
+      one commit window (``window_ticks``) at any sampled steady-state
+      point except transient shipping bursts (max sampled lag is
+      reported);
+    - **read-your-writes** — a writer that observed its tick can read
+      it back through the tier at ``min_horizon=`` without error.
+
+    Host-side CPU work; runs on the CPU executor/platform."""
+    import shutil
+    import tempfile
+    import threading
+
+    from reflow_tpu.obs import REGISTRY
+    from reflow_tpu.serve import (CoalesceWindow, IngestFrontend,
+                                  LeaderReadAdapter, ReadTier,
+                                  ReplicaScheduler)
+    from reflow_tpu.wal import DurableScheduler, SegmentShipper
+    from reflow_tpu.workloads import wordcount
+
+    smoke = os.environ.get("REFLOW_BENCH_SMOKE") == "1"
+    n_replicas = int(os.environ.get("REFLOW_BENCH_REPLICA_N", "4"))
+    n_producers = 16
+    n_readers = 4
+    window_ticks = 4
+    vocab = 2_000 if smoke else 20_000
+    read_s = float(os.environ.get(
+        "REFLOW_BENCH_REPLICA_READ_S", "0.6" if smoke else "2.0"))
+    topk = 10
+
+    tmp = tempfile.mkdtemp(prefix="reflow-replica-")
+    out = {"replicas": n_replicas, "producers": n_producers,
+           "readers": n_readers, "window_ticks": window_ticks,
+           "read_s": read_s, "vocab": vocab}
+    fe = ship = None
+    replicas = []
+    try:
+        g, src, sink = wordcount.build_graph()
+        sched = DurableScheduler(g, wal_dir=os.path.join(tmp, "wal"),
+                                 fsync="tick", committer="thread",
+                                 segment_bytes=1 << 20)
+        fe = IngestFrontend(sched, window=CoalesceWindow(
+            max_rows=65536, max_ticks=window_ticks, max_latency_s=0.002))
+        ship = SegmentShipper(sched.wal, leader_tick=lambda: sched._tick,
+                              poll_s=0.001)
+        for i in range(n_replicas):
+            gr, _s, _k = wordcount.build_graph()
+            r = ReplicaScheduler(gr, os.path.join(tmp, f"r{i}"),
+                                 name=f"r{i}")
+            ship.attach(r)
+            r.publish_metrics()
+            replicas.append(r)
+        leader = LeaderReadAdapter(sched)
+        tier = ReadTier(replicas, leader=leader)
+        ship.publish_metrics()
+        tier.publish_metrics()
+        ship.start()
+
+        # -- sustained 16-producer writes for the whole measured region
+        stop = threading.Event()
+        submitted = [0] * n_producers
+
+        def produce(pid):
+            rng = np.random.default_rng(1000 + pid)
+            seq = 0
+            while not stop.is_set():
+                words = " ".join(
+                    f"w{int(x)}" for x in rng.integers(0, vocab, 24))
+                try:
+                    fe.submit(src, wordcount.ingest_lines([words]),
+                              batch_id=f"p{pid}-{seq}")
+                except Exception:
+                    break
+                seq += 1
+            submitted[pid] = seq
+
+        producers = [threading.Thread(target=produce, args=(pid,))
+                     for pid in range(n_producers)]
+        for t in producers:
+            t.start()
+
+        lag_samples: list = []
+        lag_stop = threading.Event()
+
+        def sample_lag():
+            while not lag_stop.is_set():
+                lag_samples.append(max(r.lag_ticks() for r in replicas))
+                lag_stop.wait(0.02)
+
+        lag_thread = threading.Thread(target=sample_lag)
+        lag_thread.start()
+        time.sleep(0.5)  # build up a real view before measuring reads
+
+        def read_qps(fn) -> float:
+            counts = [0] * n_readers
+
+            def reader(i):
+                end = time.perf_counter() + read_s
+                c = 0
+                while time.perf_counter() < end:
+                    fn()
+                    c += 1
+                counts[i] = c
+
+            threads = [threading.Thread(target=reader, args=(i,))
+                       for i in range(n_readers)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return sum(counts) / read_s
+
+        # warm both read paths before measuring (first replica reads
+        # pay one-off snapshot builds; first leader read pays the view
+        # copy's allocator warmup) so short smoke legs compare steady
+        # states, not cold starts
+        for _ in range(8):
+            leader.top_k(sink.name, topk, by="value")
+            tier.top_k(sink.name, topk, by="value")
+
+        leader_qps = read_qps(
+            lambda: leader.top_k(sink.name, topk, by="value"))
+        log(f"replica[leader-baseline]: {leader_qps:.0f} reads/s "
+            f"under {n_producers}p writes")
+        replica_qps = read_qps(
+            lambda: tier.top_k(sink.name, topk, by="value"))
+        log(f"replica[{n_replicas}-replica tier]: {replica_qps:.0f} "
+            f"reads/s under {n_producers}p writes")
+
+        # read-your-writes: a writer that saw its window land can pin
+        # the tier to at least that horizon
+        fe.submit(src, wordcount.ingest_lines(["ryw probe words"]),
+                  batch_id="ryw-1").result(timeout=60)
+        h = sched._tick
+        res = tier.top_k(sink.name, topk, min_horizon=h, by="value")
+        out["ryw_min_horizon"] = h
+        out["ryw_horizon"] = res.horizon
+        out["ryw_source"] = res.source
+        assert res.horizon >= h
+
+        # -- quiesce: stop writers, land everything, let replicas catch up
+        stop.set()
+        for t in producers:
+            t.join()
+        fe.flush()
+        sched.wal.sync()
+        deadline = time.monotonic() + 60
+        while (any(r.published_horizon() != sched._tick
+                   for r in replicas)
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        lag_stop.set()
+        lag_thread.join()
+        ship.stop()
+        ship.pump_once()  # final deterministic pass (thread is down)
+
+        final_lag = max(r.lag_ticks() for r in replicas)
+        out["final_lag_ticks"] = final_lag
+        out["max_sampled_lag_ticks"] = max(lag_samples, default=0)
+        out["lag_bound_ok"] = final_lag <= window_ticks
+        assert all(r.published_horizon() == sched._tick
+                   for r in replicas), \
+            (sched._tick, [r.published_horizon() for r in replicas])
+
+        # -- exact parity at the shared horizon
+        leader_view = {kv: w for kv, w in sched.view(sink.name).items()
+                       if w != 0}
+        max_abs_diff = 0
+        for r in replicas:
+            rh, rv = r.view_at(sink.name)
+            assert rh == sched._tick, (r.name, rh, sched._tick)
+            for kv in set(leader_view) | set(rv):
+                max_abs_diff = max(
+                    max_abs_diff,
+                    abs(leader_view.get(kv, 0) - rv.get(kv, 0)))
+        out["parity_max_abs_diff"] = max_abs_diff
+        assert max_abs_diff == 0
+
+        out["total_batches"] = sum(submitted)
+        out["leader_ticks"] = sched._tick
+        out["leader_read_qps"] = round(leader_qps, 1)
+        out["replica_read_qps"] = round(replica_qps, 1)
+        out["read_scaling_x"] = round(replica_qps / leader_qps, 3) \
+            if leader_qps else 0.0
+        out["ship_bytes_total"] = ship.bytes_total
+        out["ship_nacks"] = ship.nacks
+        out["ship_backlog_segments"] = ship.backlog_segments()
+        out["lag_gauge"] = REGISTRY.value("replica.lag_ticks", -1)
+        log(f"replica[scaling]: {out['read_scaling_x']}x "
+            f"({n_replicas} replicas vs leader), parity diff "
+            f"{max_abs_diff}, final lag {final_lag} tick(s), "
+            f"{ship.bytes_total} bytes shipped, {ship.nacks} nacks")
+    finally:
+        if fe is not None:
+            fe.close()
+        if ship is not None:
+            ship.close()
+        for r in replicas:
+            r.close()
         shutil.rmtree(tmp, ignore_errors=True)
     return out
 
@@ -2108,6 +2344,18 @@ def main() -> None:
         _emit({
             "metric": "walpipe_speedup_16p",
             "value": out["walpipe_speedup_16p"],
+            "unit": "x",
+            **out,
+        }, json_out)
+        return
+
+    if os.environ.get("REFLOW_BENCH_REPLICA") == "1":
+        # replica mode is host-side CPU work — no tunnel, no subprocesses
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        out = run_replica_bench()
+        _emit({
+            "metric": "replica_read_scaling_x",
+            "value": out["read_scaling_x"],
             "unit": "x",
             **out,
         }, json_out)

@@ -14,9 +14,12 @@ tour.
 from .budget import AdmissionBudget, BudgetShare
 from .coalesce import CoalesceWindow, Feed, build_feeds
 from .control import (Autoscaler, BrownoutLadder, CircuitBreaker,
-                      ControlConfig, ControlPlane, SLOSpec)
+                      ControlConfig, ControlPlane, SLOSpec,
+                      load_slo_specs)
 from .frontend import IngestFrontend
 from .queues import batch_nbytes
+from .read import LeaderReadAdapter, ReadResult, ReadTier, StaleRead
+from .replica import ReplicaScheduler
 from .tickets import (APPLIED, DEDUPED, REJECTED, SHED, FrontendClosed,
                       PumpCrashed, Ticket, TicketResult)
 from .tier import GraphConfig, GraphHandle, ServeTier, dwrr_pick
@@ -26,6 +29,8 @@ __all__ = [
     "AdmissionBudget", "Autoscaler", "BrownoutLadder", "BudgetShare",
     "CircuitBreaker", "CoalesceWindow", "ControlConfig", "ControlPlane",
     "Feed", "FrontendClosed", "GraphConfig", "GraphHandle",
-    "IngestFrontend", "PumpCrashed", "SLOSpec", "ServeTier", "Ticket",
-    "TicketResult", "batch_nbytes", "build_feeds", "dwrr_pick",
+    "IngestFrontend", "LeaderReadAdapter", "PumpCrashed", "ReadResult",
+    "ReadTier", "ReplicaScheduler", "SLOSpec", "ServeTier", "StaleRead",
+    "Ticket", "TicketResult", "batch_nbytes", "build_feeds", "dwrr_pick",
+    "load_slo_specs",
 ]

@@ -79,7 +79,7 @@ from reflow_tpu.utils.metrics import percentile
 from .frontend import POLICIES
 
 __all__ = ["SLOSpec", "BrownoutLadder", "CircuitBreaker", "Autoscaler",
-           "ControlConfig", "ControlPlane"]
+           "ControlConfig", "ControlPlane", "load_slo_specs"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -128,6 +128,53 @@ class SLOSpec:
                 and info.get("occupancy", 0.0) > self.budget_occupancy):
             return True
         return False
+
+
+def load_slo_specs(path: str) -> Dict[str, SLOSpec]:
+    """Parse per-graph :class:`SLOSpec`s from a JSON config file so
+    operators can retune brownout ladders without code::
+
+        {"default_slo": {"sched_delay_p99_s": 0.5},
+         "specs": {"hot-tenant": {"budget_occupancy": 0.9,
+                                  "ladder": ["reject", "shed-oldest"],
+                                  "breach_intervals": 2}}}
+
+    ``default_slo`` (optional) supplies field defaults every spec
+    inherits; each entry under ``specs`` overrides per graph. Unknown
+    fields and invalid ladder policies fail loudly — a typo'd config
+    must not silently disable an SLO."""
+    import json
+
+    with open(path) as f:
+        raw = json.load(f)
+    if not isinstance(raw, dict):
+        raise ValueError(f"{path}: SLO config must be a JSON object")
+    unknown = set(raw) - {"specs", "default_slo"}
+    if unknown:
+        raise ValueError(f"{path}: unknown top-level keys {sorted(unknown)}")
+    fields = {f.name for f in dataclasses.fields(SLOSpec)}
+
+    def build(name: str, entry: Dict) -> SLOSpec:
+        if not isinstance(entry, dict):
+            raise ValueError(f"{path}: spec {name!r} must be an object")
+        bad = set(entry) - fields
+        if bad:
+            raise ValueError(f"{path}: spec {name!r} has unknown "
+                             f"fields {sorted(bad)} (valid: "
+                             f"{sorted(fields)})")
+        merged = dict(raw.get("default_slo") or {})
+        merged.update(entry)
+        if "ladder" in merged:
+            merged["ladder"] = tuple(merged["ladder"])
+        return SLOSpec(**merged)
+
+    if raw.get("default_slo"):
+        bad = set(raw["default_slo"]) - fields
+        if bad:
+            raise ValueError(f"{path}: default_slo has unknown fields "
+                             f"{sorted(bad)}")
+    return {name: build(name, entry)
+            for name, entry in (raw.get("specs") or {}).items()}
 
 
 class BrownoutLadder:
@@ -431,13 +478,19 @@ class ControlPlane:
     """
 
     def __init__(self, tier, *, specs: Optional[Dict[str, SLOSpec]] = None,
-                 config: Optional[ControlConfig] = None, registry=None,
+                 config: Optional[ControlConfig] = None,
+                 config_path: Optional[str] = None, registry=None,
                  clock: Callable[[], float] = time.monotonic,
                  rng: Optional[Callable[[], float]] = None,
                  sampler: Optional[Callable[[float], Dict]] = None):
         from reflow_tpu.obs import REGISTRY
         self.tier = tier
-        self.specs = dict(specs) if specs else {}
+        # file first, explicit specs= override per graph — an operator
+        # config sets the fleet default, code pins the exceptions
+        self.specs = (dict(load_slo_specs(config_path))
+                      if config_path is not None else {})
+        if specs:
+            self.specs.update(specs)
         self.config = config if config is not None else ControlConfig()
         self.registry = registry if registry is not None else REGISTRY
         self._clock = clock

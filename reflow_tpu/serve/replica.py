@@ -1,0 +1,441 @@
+"""Read replicas: continuous WAL tail replay at a published tick horizon.
+
+A :class:`ReplicaScheduler` is the follower end of the WAL shipping
+protocol (``wal/ship.py``). It mirrors the leader's CRC-framed segments
+into a local directory, replays them through the exact idempotent
+machinery crash recovery already trusts (``wal.recovery.replay_records``
+— a replayed push dedups by batch id, a replayed tick below the counter
+is skipped), and publishes a **tick horizon**: reads are answered from
+a snapshot of the sink views as of a whole number of commit windows.
+Readers never see half a window.
+
+Three invariants carry the design:
+
+- **Holdback**: shipped records are staged and applied only through the
+  *last tick marker* received. Pushes past it — a commit window still in
+  flight — touch nothing, not even the pending buffers, until their
+  marker arrives. A torn or tampered shipment is therefore rejected
+  whole (NACK with the replica's authoritative cursor) and a partial
+  commit window is never applied, no matter where the transport died.
+- **Restart-resume**: the replica checkpoints its own scheduler state
+  (stamping the applied WAL position into ``meta.pkl``, exactly the
+  contract ``recover()`` reads) and persists its ship cursor next to the
+  checkpoint. A restart restores checkpoint + mirrored tail and
+  re-subscribes from where it left off — never from segment 0.
+- **Immutable read snapshots**: each published horizon lazily
+  materializes per-sink arrays (keys + weights) that are never mutated
+  afterward, so ``top_k`` is a lock-free ``np.argpartition`` over frozen
+  numpy buffers — reads scale with replica count instead of serializing
+  on the leader's live, mutable views.
+
+``promote()`` is a stub: failover is a control-plane actuator for a
+later PR; replicas currently serve reads only.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import threading
+import time
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from reflow_tpu.obs import trace as _trace
+from reflow_tpu.obs.registry import REGISTRY
+from reflow_tpu.scheduler import DirtyScheduler
+from reflow_tpu.wal.log import (_MAGIC, LogPosition, WalError, _repair_tail,
+                                _seg_path, list_segments)
+from reflow_tpu.wal.recovery import replay_records
+from reflow_tpu.wal.ship import ShipAck, Shipment, ShipNack, iter_frames
+
+__all__ = ["ReplicaScheduler", "CURSOR_FILE"]
+
+CURSOR_FILE = "cursor.json"
+CURSOR_SCHEMA = "reflow.replica_cursor/1"
+
+
+class _Snapshot(NamedTuple):
+    """Frozen per-sink read state at one published horizon. ``keys`` and
+    ``weights`` are never mutated after construction: ``top_k`` runs
+    ``np.argpartition`` on them without holding any lock."""
+
+    horizon: int
+    keys: List[tuple]
+    weights: np.ndarray
+    #: per-row scalar values, when the sink's values are numeric (the
+    #: unique-keyed aggregate case, e.g. wordcount's (word, count) rows
+    #: at weight 1) — None for non-numeric payloads
+    values: Optional[np.ndarray]
+    index: Dict[tuple, float]
+
+
+class ReplicaScheduler:
+    """A follower that replays shipped WAL windows into its own
+    ``DirtyScheduler`` and serves snapshot reads at a published horizon.
+
+    ``replica_dir`` holds everything the replica needs to resume:
+    ``wal/`` (the mirrored leader segments), ``ckpt/`` (its own
+    checkpoints) and ``cursor.json`` (the ship cursor, leader
+    coordinates). Build it with the same graph the leader runs;
+    ``executor=None`` gives the CPU oracle, which is what a read tier
+    wants — views are host Counters either way."""
+
+    def __init__(self, graph, replica_dir: str, *, executor=None,
+                 name: Optional[str] = None) -> None:
+        self.graph = graph
+        self.replica_dir = replica_dir
+        self.mirror_dir = os.path.join(replica_dir, "wal")
+        self.ckpt_dir = os.path.join(replica_dir, "ckpt")
+        os.makedirs(self.mirror_dir, exist_ok=True)
+        os.makedirs(self.ckpt_dir, exist_ok=True)
+        self.name = name or (os.path.basename(os.path.normpath(replica_dir))
+                             or "replica")
+        self.sched = DirtyScheduler(graph, executor)
+        self._lock = threading.RLock()
+        #: parsed-but-unapplied records (the holdback buffer): entries
+        #: are (pos, end_pos, record); only a suffix past the last
+        #: applied tick marker ever lives here
+        self._staged: List[Tuple[LogPosition, LogPosition, dict]] = []
+        self._cursor: Optional[LogPosition] = None   # next byte expected
+        self._applied: Optional[LogPosition] = None  # end of last applied
+        self._horizon = 0
+        self._leader_tick = 0
+        self._snapshots: Dict[str, _Snapshot] = {}
+        self.shipments = 0
+        self.records_applied = 0
+        self.windows_applied = 0
+        self.crc_rejects = 0
+        self.order_rejects = 0
+        self.bootstraps = 0
+        self.restored_from: Optional[str] = None
+        self._metric_names: List[str] = []
+        self._restore()
+
+    # -- transport surface (the watermark handshake) -----------------------
+
+    def subscribe(self) -> Optional[Tuple[int, int]]:
+        """The replica's persisted resume cursor in leader coordinates,
+        or None for a fresh replica (the shipper then bootstraps)."""
+        with self._lock:
+            return tuple(self._cursor) if self._cursor is not None else None
+
+    def bootstrap(self, ckpt_dir: str) -> Tuple[int, int]:
+        """Checkpoint-anchored catch-up: load the *leader's* checkpoint
+        and resume shipping from its recorded WAL position — always a
+        segment start, so leader and mirror coordinates agree on every
+        byte after it. Immediately re-checkpoints locally so a restart
+        never needs the leader's files again."""
+        from reflow_tpu.utils.checkpoint import load_checkpoint
+
+        with self._lock:
+            meta = load_checkpoint(self.sched, ckpt_dir)
+            pos = meta.get("wal_pos")
+            if pos is None:
+                raise WalError(f"{ckpt_dir}: leader checkpoint has no "
+                               f"wal_pos — cannot anchor a replica on it")
+            self._cursor = LogPosition(*pos)
+            self._applied = self._cursor
+            self._horizon = self.sched._tick
+            self._staged.clear()
+            self._snapshots = {}
+            self.bootstraps += 1
+        self.checkpoint()
+        return tuple(self._cursor)
+
+    def receive(self, sh: Shipment):
+        """Verify, mirror, stage and (window-complete) apply one
+        shipment. Returns :class:`ShipAck` with the advanced cursor and
+        the new horizon, or :class:`ShipNack` carrying the replica's
+        authoritative cursor for the shipper to resume from."""
+        t0 = time.perf_counter()
+        with self._lock:
+            self.shipments += 1
+            cur = self._cursor
+            if cur is None:
+                # an unanchored fresh replica may only start at a
+                # segment's first frame
+                if sh.offset != len(_MAGIC):
+                    self.order_rejects += 1
+                    return ShipNack(None, "fresh replica needs a segment "
+                                          "start")
+                cur = LogPosition(sh.segment, sh.offset)
+            if (sh.segment, sh.offset) != tuple(cur):
+                self.order_rejects += 1
+                return ShipNack(tuple(cur),
+                                f"out of order: expected {tuple(cur)}, "
+                                f"got {(sh.segment, sh.offset)}")
+            entries, valid, reason = iter_frames(sh.payload, sh.segment,
+                                                 sh.offset)
+            if valid != len(sh.payload) \
+                    or sh.offset + valid != sh.end_offset:
+                # reject the shipment whole: nothing mirrored, nothing
+                # staged, cursor unmoved — the shipper re-reads from it
+                self.crc_rejects += 1
+                return ShipNack(tuple(cur),
+                                reason or "end_offset mismatch")
+            self._mirror_append(sh)
+            self._staged.extend(entries)
+            applied = self._apply_staged()
+            if sh.seals:
+                nxt = (sh.next_segment if sh.next_segment is not None
+                       else sh.segment + 1)
+                self._cursor = LogPosition(nxt, len(_MAGIC))
+            else:
+                self._cursor = LogPosition(sh.segment, sh.end_offset)
+            self._leader_tick = max(self._leader_tick, sh.leader_tick)
+            self._persist_cursor()
+            ack = ShipAck(tuple(self._cursor), self._horizon)
+        if _trace.ENABLED:
+            _trace.evt("replica_replay", t0, time.perf_counter() - t0,
+                       track=f"replica/{self.name}",
+                       args={"segment": sh.segment, "bytes": len(sh.payload),
+                             "records": len(entries), "applied": applied,
+                             "horizon": ack.horizon,
+                             "lag_ticks": self.lag_ticks()})
+        return ack
+
+    def _mirror_append(self, sh: Shipment) -> None:
+        path = _seg_path(self.mirror_dir, sh.segment)
+        if not os.path.exists(path):
+            if sh.offset != len(_MAGIC):
+                raise WalError(f"mirror gap: shipment for "
+                               f"wal-{sh.segment:08d}.log @ {sh.offset} "
+                               f"but no local segment")
+            with open(path, "wb") as f:
+                f.write(_MAGIC)
+        size = os.path.getsize(path)
+        if size > sh.offset:
+            # an acked-but-forgotten overlap (shipper resumed behind us
+            # after a NACK storm): drop our unacked surplus and re-land
+            with open(path, "rb+") as f:
+                f.truncate(sh.offset)
+        elif size < sh.offset:
+            raise WalError(f"mirror gap: wal-{sh.segment:08d}.log is "
+                           f"{size} bytes, shipment starts at {sh.offset}")
+        with open(path, "ab") as f:
+            f.write(sh.payload)
+            f.flush()
+
+    def _apply_staged(self) -> int:
+        """Apply staged records through the LAST tick marker; everything
+        past it stays held back. Returns records applied."""
+        last = None
+        for i in range(len(self._staged) - 1, -1, -1):
+            if self._staged[i][2].get("kind") == "tick":
+                last = i
+                break
+        if last is None:
+            return 0
+        window = self._staged[:last + 1]
+        del self._staged[:last + 1]
+        _rep, _ded, ticks, _skip = replay_records(
+            self.sched, [(p, r) for p, _e, r in window])
+        self.records_applied += len(window)
+        self.windows_applied += ticks
+        self._applied = window[-1][1]
+        self._horizon = self.sched._tick
+        self._snapshots = {}
+        return len(window)
+
+    # -- persistence -------------------------------------------------------
+
+    def _persist_cursor(self) -> None:
+        state = {
+            "schema": CURSOR_SCHEMA,
+            "cursor": list(self._cursor) if self._cursor else None,
+            "applied": list(self._applied) if self._applied else None,
+            "horizon": self._horizon,
+            "leader_tick": self._leader_tick,
+        }
+        path = os.path.join(self.replica_dir, CURSOR_FILE)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(state, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # advisory: restart re-derives the cursor from disk
+
+    def checkpoint(self) -> str:
+        """Checkpoint the replica's own scheduler state, stamping the
+        applied WAL position into the meta so a restart resumes replay
+        exactly where reads last saw — the same ``wal_pos`` contract
+        ``recover()`` uses, written by hand because a replica's plain
+        scheduler has no WAL of its own to rotate."""
+        from reflow_tpu.utils.checkpoint import save_checkpoint
+
+        with self._lock:
+            save_checkpoint(self.sched, self.ckpt_dir)
+            meta_path = os.path.join(self.ckpt_dir, "meta.pkl")
+            with open(meta_path, "rb") as f:
+                meta = pickle.load(f)
+            pos = self._applied if self._applied is not None \
+                else self._cursor
+            if pos is not None:
+                meta["wal_pos"] = tuple(pos)
+            tmp = meta_path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(meta, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, meta_path)
+            self._persist_cursor()
+        return self.ckpt_dir
+
+    def _restore(self) -> None:
+        """Restart-resume: local checkpoint (if any) + mirrored tail.
+        The cursor comes out at the end of the mirror's valid prefix —
+        never segment 0 unless the replica truly is fresh."""
+        from reflow_tpu.utils.checkpoint import load_checkpoint
+
+        start: Optional[Tuple[int, int]] = None
+        if os.path.exists(os.path.join(self.ckpt_dir, "meta.pkl")):
+            meta = load_checkpoint(self.sched, self.ckpt_dir)
+            start = meta.get("wal_pos")
+            self._horizon = self.sched._tick
+            self.restored_from = "checkpoint"
+        segs = list_segments(self.mirror_dir)
+        if segs:
+            # a kill mid-append leaves a torn mirror tail; drop it (the
+            # shipper re-sends from our recomputed cursor)
+            _repair_tail(segs[-1][1], segs[-1][0])
+            segs = list_segments(self.mirror_dir)
+        cursor = LogPosition(*start) if start is not None else None
+        self._applied = cursor
+        had_ckpt = self.restored_from == "checkpoint"
+        had_tail = False
+        for seq, path in segs:
+            if start is not None and seq < start[0]:
+                continue
+            with open(path, "rb") as f:
+                data = f.read()
+            if data[:len(_MAGIC)] != _MAGIC:
+                continue
+            entries, _valid, _reason = iter_frames(
+                data[len(_MAGIC):], seq, len(_MAGIC))
+            for p, e, r in entries:
+                if start is not None and p.segment == start[0] \
+                        and p.offset < start[1]:
+                    continue
+                self._staged.append((p, e, r))
+                cursor = e if cursor is None or e > cursor else cursor
+            had_tail = had_tail or bool(entries)
+        if had_tail:
+            self.restored_from = "checkpoint+tail" if had_ckpt else "tail"
+        if self._staged:
+            self._apply_staged()
+        # NOTE: cursor.json is deliberately NOT consulted here — it can
+        # run AHEAD of a torn mirror tail (persisted, then the appended
+        # bytes died with the process), and resuming past bytes the
+        # mirror lost would skip records forever. Checkpoint + mirror
+        # walk is always sufficient: bootstrap checkpoints immediately,
+        # so the persisted wal_pos anchors every resume.
+        self._cursor = cursor
+        self._horizon = self.sched._tick
+
+    # -- read surface ------------------------------------------------------
+
+    def published_horizon(self) -> int:
+        """Tick counter as of the last fully-applied commit window."""
+        return self._horizon
+
+    def lag_ticks(self) -> int:
+        """Published horizon's distance behind the leader tick last seen
+        on a shipment (0 when fully caught up)."""
+        return max(0, self._leader_tick - self._horizon)
+
+    def _snapshot(self, sink) -> _Snapshot:
+        name = sink if isinstance(sink, str) else sink.name
+        snap = self._snapshots.get(name)
+        h = self._horizon
+        if snap is not None and snap.horizon == h:
+            return snap
+        with self._lock:
+            snap = self._snapshots.get(name)
+            if snap is None or snap.horizon != self._horizon:
+                view = self.sched.sink_views[name]
+                items = [(kv, w) for kv, w in view.items() if w != 0]
+                try:
+                    values = np.asarray([kv[1] for kv, _ in items],
+                                        dtype=np.float64)
+                except (TypeError, ValueError, IndexError):
+                    values = None
+                if values is not None and values.ndim != 1:
+                    values = None
+                snap = _Snapshot(
+                    self._horizon,
+                    [kv for kv, _ in items],
+                    np.asarray([w for _, w in items], dtype=np.float64),
+                    values,
+                    dict(items))
+                self._snapshots[name] = snap
+        return snap
+
+    def top_k(self, sink, k: int, *, by: str = "weight",
+              ) -> Tuple[int, List[Tuple[tuple, float]]]:
+        """Top ``k`` sink entries at the snapshot's horizon:
+        ``(horizon, [((key, value), weight), ...])`` descending.
+        ``by="weight"`` ranks by multiset weight; ``by="value"`` ranks
+        by the row's scalar value — the natural order for unique-keyed
+        aggregate sinks, where the count lives in the value and every
+        live row has weight 1. The hot path is a lock-free argpartition
+        over frozen arrays."""
+        snap = self._snapshot(sink)
+        n = len(snap.keys)
+        if n == 0:
+            return max(snap.horizon, 0), []
+        if by == "value":
+            if snap.values is None:
+                raise ValueError(f"sink {sink!r} has non-numeric values; "
+                                 f"top_k(by='value') needs scalars")
+            rank = snap.values
+        elif by == "weight":
+            rank = snap.weights
+        else:
+            raise ValueError(f"by={by!r}: expected 'weight' or 'value'")
+        kk = min(int(k), n)
+        idx = np.argpartition(rank, n - kk)[n - kk:]
+        idx = idx[np.argsort(rank[idx])[::-1]]
+        return snap.horizon, [(snap.keys[int(i)], float(snap.weights[i]))
+                              for i in idx]
+
+    def lookup(self, sink, key) -> Tuple[int, float]:
+        """Weight of one ``(key, value)`` sink entry at the snapshot's
+        horizon (0.0 when absent)."""
+        snap = self._snapshot(sink)
+        return max(snap.horizon, 0), float(snap.index.get(key, 0.0))
+
+    def view_at(self, sink) -> Tuple[int, Dict[tuple, float]]:
+        """Full sink view copy at the snapshot's horizon — parity
+        checks and small views; ``top_k`` is the scaling read."""
+        snap = self._snapshot(sink)
+        return max(snap.horizon, 0), dict(snap.index)
+
+    # -- lifecycle / observability -----------------------------------------
+
+    def promote(self):
+        """Failover actuator stub: a later PR wires the control plane to
+        re-point ingestion at a promoted replica; today replicas serve
+        reads only."""
+        raise NotImplementedError(
+            "promote-on-failure is a control-plane actuator stub")
+
+    def publish_metrics(self, registry=None,
+                        name: Optional[str] = None) -> None:
+        reg = registry if registry is not None else REGISTRY
+        base = name or f"replica.{self.name}"
+        reg.gauge(f"{base}.lag_ticks", self.lag_ticks)
+        reg.gauge(f"{base}.horizon", lambda: self._horizon)
+        reg.gauge(f"{base}.records_applied",
+                  lambda: self.records_applied)
+        reg.gauge(f"{base}.crc_rejects", lambda: self.crc_rejects)
+        reg.gauge(f"{base}.staged_records", lambda: len(self._staged))
+        self._metric_names.append(base)
+
+    def close(self) -> None:
+        for base in self._metric_names:
+            REGISTRY.unregister_prefix(base)
+        self._metric_names.clear()

@@ -15,14 +15,21 @@ torn tail writes, and crashes between ``push`` and ``tick``.
 from reflow_tpu.wal.durable import DurableScheduler
 from reflow_tpu.wal.log import (LogPosition, WalError, WriteAheadLog,
                                 scan_wal)
-from reflow_tpu.wal.recovery import RecoveryReport, recover
+from reflow_tpu.wal.recovery import RecoveryReport, recover, replay_records
+from reflow_tpu.wal.ship import (SegmentShipper, ShipAck, Shipment,
+                                 ShipNack)
 
 __all__ = [
     "DurableScheduler",
     "LogPosition",
     "RecoveryReport",
+    "SegmentShipper",
+    "ShipAck",
+    "ShipNack",
+    "Shipment",
     "WalError",
     "WriteAheadLog",
     "recover",
+    "replay_records",
     "scan_wal",
 ]

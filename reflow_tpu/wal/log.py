@@ -223,6 +223,13 @@ class WriteAheadLog:
         self._written_lsn = 0
         self._flushed_lsn = 0
         self._synced_lsn = 0
+        #: byte-position twin of ``_synced_lsn``: everything strictly
+        #: before this (segment, offset) is on disk AND fsynced — the
+        #: prefix a WAL shipper (wal/ship.py) may stream to followers.
+        #: Maintained from ``_lsn_pos`` (frame LSN -> frame end
+        #: position), popped as the synced watermark advances.
+        self._synced_pos = LogPosition(self._seq, len(_MAGIC))
+        self._lsn_pos: Deque[Tuple[int, int, int]] = deque()
         #: committer work queue, strictly FIFO == LSN order:
         #: ("frame", bytes, lsn) | ("rotate", new_seq, cover_lsn) |
         #: ("fsync", target_lsn, t_enqueued)
@@ -294,6 +301,7 @@ class WriteAheadLog:
         self.bytes_written += len(frame)
         self._written_lsn += 1
         lsn = self._written_lsn
+        self._lsn_pos.append((lsn, pos.segment, pos.offset + len(frame)))
         self._io_q.append(("frame", frame, lsn))
         if self._offset >= self.segment_bytes:
             # bookkeeping rotation: later frames get positions in the
@@ -331,6 +339,7 @@ class WriteAheadLog:
         self._written_lsn += 1
         self._flushed_lsn = self._written_lsn
         lsn = self._written_lsn
+        self._lsn_pos.append((lsn, pos.segment, pos.offset + len(frame)))
         self.append_s.append(time.perf_counter() - t0)
         if _trace.ENABLED:
             _trace.evt("wal_append", t0, time.perf_counter() - t0,
@@ -497,6 +506,9 @@ class WriteAheadLog:
     def _advance_synced(self, cover: int) -> None:
         # caller holds self._lock
         self._synced_lsn = cover
+        while self._lsn_pos and self._lsn_pos[0][0] <= cover:
+            _lsn, seg, end = self._lsn_pos.popleft()
+            self._synced_pos = LogPosition(seg, end)
         while self._fsync_q and self._fsync_q[0][0] <= cover:
             self._fsync_q.popleft()
         self._durable_cv.notify_all()
@@ -671,6 +683,10 @@ class WriteAheadLog:
             # reported failed to its caller
             self._flushed_lsn = self._written_lsn
             self._synced_lsn = self._written_lsn
+            # the dropped frames never reached the disk: the shippable
+            # prefix restarts at the fresh segment, never mid-loss
+            self._lsn_pos.clear()
+            self._synced_pos = LogPosition(self._seq, len(_MAGIC))
             self._unsynced_appends = 0
             self._io_q.clear()
             self._fsync_q.clear()
@@ -751,6 +767,16 @@ class WriteAheadLog:
         assigned at append time)."""
         with self._lock:
             return LogPosition(self._seq, self._offset)
+
+    def synced_position(self) -> LogPosition:
+        """Byte-position twin of the *synced* watermark: every frame
+        strictly before this (segment, offset) is written AND fsynced
+        (power-loss durable). This is the prefix a shipper
+        (``wal/ship.py``) may stream to read replicas — bytes past it
+        may still be sitting in the committer queue or the page cache,
+        and a power loss could take them back."""
+        with self._lock:
+            return self._synced_pos
 
     def rotate(self) -> None:
         """Seal the current segment and open the next one. The sealed

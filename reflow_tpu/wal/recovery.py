@@ -38,7 +38,7 @@ from typing import Optional
 from reflow_tpu.delta import DeltaBatch
 from reflow_tpu.wal.log import TornTail, scan_wal
 
-__all__ = ["RecoveryReport", "recover"]
+__all__ = ["RecoveryReport", "recover", "replay_records"]
 
 
 @dataclasses.dataclass
@@ -74,6 +74,50 @@ def _resolve_source(sched, rec):
     return node
 
 
+def replay_records(sched, records) -> tuple:
+    """Replay scanned WAL records through ``sched``'s ordinary
+    ``push(batch_id=...)`` / ``tick()`` path — the idempotent core shared
+    by :func:`recover` and the read replicas' continuous replay
+    (``serve/replica.py``). ``records`` is an iterable of ``(pos, rec)``
+    pairs (positions are ignored; a bare record iterable also works when
+    each element is a 2-tuple ending in the record dict). A
+    ``DurableScheduler`` caller must suspend its own re-logging around
+    this (``recover`` does; replicas run a plain scheduler). Returns
+    ``(replayed_pushes, deduped_pushes, replayed_ticks, skipped_ticks)``.
+    """
+    replayed = deduped = ticks_done = ticks_skipped = 0
+    for _pos, rec in records:
+        kind = rec.get("kind")
+        if kind == "push":
+            batch = DeltaBatch(rec["keys"], rec["values"],
+                               rec["weights"])
+            node = _resolve_source(sched, rec)
+            ids = rec.get("batch_ids")
+            if ids is None:
+                if sched.push(node, batch, batch_id=rec["batch_id"]):
+                    replayed += 1
+                else:
+                    deduped += 1
+            elif any(b in sched._seen_batch_ids for b in ids):
+                # a coalesced frontend feed batch: its micro-batch
+                # ids committed atomically with the macro-tick, so
+                # the replay is all-or-nothing too
+                deduped += 1
+            else:
+                for b in ids:
+                    sched._register_batch_id(b)
+                sched.push(node, batch)
+                replayed += 1
+        elif kind == "tick":
+            if rec["tick"] > sched._tick:
+                sched.tick()
+                ticks_done += 1
+            else:
+                ticks_skipped += 1
+        # "ckpt" and unknown kinds: informational, skip
+    return replayed, deduped, ticks_done, ticks_skipped
+
+
 def recover(sched, wal_dir: str, ckpt_dir: Optional[str] = None,
             ) -> RecoveryReport:
     """Restore ``sched`` (fresh, same graph/executor as the crashed run)
@@ -98,40 +142,12 @@ def recover(sched, wal_dir: str, ckpt_dir: Optional[str] = None,
         # a DurableScheduler already repaired the crashed generation's
         # torn tail when it opened the log; surface that here
         torn = getattr(getattr(sched, "wal", None), "repaired_tail", None)
-    replayed = deduped = ticks_done = ticks_skipped = 0
     suspended = getattr(sched, "_wal_suspended", None)
     if suspended is not None:
         sched._wal_suspended = True
     try:
-        for _pos, rec in records:
-            kind = rec.get("kind")
-            if kind == "push":
-                batch = DeltaBatch(rec["keys"], rec["values"],
-                                   rec["weights"])
-                node = _resolve_source(sched, rec)
-                ids = rec.get("batch_ids")
-                if ids is None:
-                    if sched.push(node, batch, batch_id=rec["batch_id"]):
-                        replayed += 1
-                    else:
-                        deduped += 1
-                elif any(b in sched._seen_batch_ids for b in ids):
-                    # a coalesced frontend feed batch: its micro-batch
-                    # ids committed atomically with the macro-tick, so
-                    # the replay is all-or-nothing too
-                    deduped += 1
-                else:
-                    for b in ids:
-                        sched._register_batch_id(b)
-                    sched.push(node, batch)
-                    replayed += 1
-            elif kind == "tick":
-                if rec["tick"] > sched._tick:
-                    sched.tick()
-                    ticks_done += 1
-                else:
-                    ticks_skipped += 1
-            # "ckpt" and unknown kinds: informational, skip
+        replayed, deduped, ticks_done, ticks_skipped = replay_records(
+            sched, records)
     finally:
         if suspended is not None:
             sched._wal_suspended = False

@@ -1,0 +1,432 @@
+"""WAL shipping: stream the durable log prefix to read replicas.
+
+The pipelined committer (``wal/log.py``) already maintains a *synced*
+watermark — the LSN below which every frame is written AND fsynced.
+:meth:`WriteAheadLog.synced_position` exposes its byte-position twin,
+and everything strictly before that ``(segment, offset)`` is exactly the
+prefix a follower may safely mirror: bytes past it may still be sitting
+in the committer queue or the page cache, and a power loss could take
+them back (shipping them would let a replica serve state the leader
+itself forgets on restart).
+
+:class:`SegmentShipper` tails that watermark and streams the prefix to
+N followers over a deliberately dumb, resumable protocol:
+
+- ``follower.subscribe()`` returns the follower's persisted cursor
+  (leader WAL coordinates) or ``None`` for a fresh replica. Fresh
+  replicas are **checkpoint-anchored**: if the leader keeps checkpoints,
+  the shipper calls ``follower.bootstrap(ckpt_dir)`` so catch-up replays
+  only the WAL tail, not history from segment 0. Cursor coordinates are
+  shared between leader and mirror by construction — a checkpoint's
+  recorded ``wal_pos`` is always a segment *start* (``save_checkpoint``
+  rotates first), so both sides agree on every byte after it.
+- Each :class:`Shipment` is a run of raw CRC-framed bytes from one
+  segment (no magic header), re-verified by the shipper before it leaves
+  and by the receiver before it lands. ``seals=True`` marks the end of a
+  sealed segment; ``next_segment`` tells the follower where the log
+  continues (segment numbering may skip across leader restarts).
+- The receiver answers :class:`ShipAck` (cursor advanced, new replay
+  horizon) or :class:`ShipNack` (out-of-order or CRC-rejected). A NACK
+  carries the receiver's authoritative cursor; the shipper re-reads from
+  there off disk and resends — the WAL itself is the retransmit buffer,
+  so the shipper keeps no in-flight state worth losing.
+
+Transport is in-process (followers are objects, shipping is a thread —
+same stance as the serve tier's pump pool); the protocol above is the
+part that matters, and it is exercised torn/tampered/killed in
+``tests/test_replica.py``.
+
+The shipper persists ``ship-state.json`` next to the leader's segments
+so ``tools/wal_inspect.py`` can report shipped/applied watermarks
+without importing any of this.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import zlib
+from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
+
+from reflow_tpu.obs import trace as _trace
+from reflow_tpu.obs.registry import REGISTRY
+from reflow_tpu.wal.log import (_HEADER, _MAGIC, LogPosition, WalError,
+                                list_segments)
+
+__all__ = ["Shipment", "ShipAck", "ShipNack", "SegmentShipper",
+           "iter_frames", "SHIP_STATE_FILE", "SHIP_STATE_SCHEMA"]
+
+SHIP_STATE_FILE = "ship-state.json"
+SHIP_STATE_SCHEMA = "reflow.wal_ship/1"
+
+_MAX_FRAME = 64 << 20  # sanity bound mirroring log._MAX_RECORD
+
+
+class Shipment(NamedTuple):
+    """One run of raw CRC-framed bytes from a single leader segment.
+
+    ``payload`` covers leader bytes ``[offset, end_offset)`` of
+    ``segment`` and always ends on a frame boundary. ``seals`` marks
+    that this shipment reaches the end of a sealed segment, in which
+    case ``next_segment`` is where the log continues. ``leader_tick``
+    piggybacks the leader's tick counter so receivers can publish a lag
+    gauge without a second channel."""
+
+    segment: int
+    offset: int
+    payload: bytes
+    end_offset: int
+    seals: bool
+    next_segment: Optional[int]
+    leader_tick: int
+
+
+class ShipAck(NamedTuple):
+    """Receiver accepted a shipment: ``cursor`` is its new resume
+    position (leader coordinates), ``horizon`` its published tick
+    horizon after applying any completed commit windows."""
+
+    cursor: Tuple[int, int]
+    horizon: int
+
+
+class ShipNack(NamedTuple):
+    """Receiver rejected a shipment (cursor mismatch or CRC failure).
+    ``cursor`` is the receiver's authoritative resume position — the
+    shipper re-reads from there and resends."""
+
+    cursor: Optional[Tuple[int, int]]
+    reason: str
+
+
+def iter_frames(payload: bytes, segment: int, base: int,
+                ) -> Tuple[List[Tuple[LogPosition, LogPosition, dict]],
+                           int, Optional[str]]:
+    """Walk ``payload`` (raw frames, no magic) as leader bytes starting
+    at ``(segment, base)``. Returns ``(entries, valid_len, reason)``
+    where each entry is ``(pos, end_pos, record)``; ``valid_len <
+    len(payload)`` means the walk stopped early for ``reason`` (torn
+    header, short payload, CRC mismatch, unpicklable record)."""
+    import pickle
+
+    entries: List[Tuple[LogPosition, LogPosition, dict]] = []
+    off = 0
+    n = len(payload)
+    while off < n:
+        if off + _HEADER.size > n:
+            return entries, off, "truncated frame header"
+        length, crc = _HEADER.unpack_from(payload, off)
+        if length > _MAX_FRAME:
+            return entries, off, f"implausible frame length {length}"
+        body = payload[off + _HEADER.size: off + _HEADER.size + length]
+        if len(body) < length:
+            return entries, off, (f"truncated payload "
+                                  f"({len(body)}/{length} bytes)")
+        if zlib.crc32(body) != crc:
+            return entries, off, "CRC mismatch"
+        try:
+            rec = pickle.loads(body)
+        except Exception as e:  # noqa: BLE001 - framed yet unloadable
+            return entries, off, f"unpicklable payload ({e})"
+        end = off + _HEADER.size + length
+        entries.append((LogPosition(segment, base + off),
+                        LogPosition(segment, base + end), rec))
+        off = end
+    return entries, off, None
+
+
+class _FollowerState:
+    __slots__ = ("name", "follower", "cursor", "applied_horizon",
+                 "bytes_total", "shipments", "nacks", "bootstraps")
+
+    def __init__(self, name: str, follower) -> None:
+        self.name = name
+        self.follower = follower
+        self.cursor: Optional[LogPosition] = None
+        self.applied_horizon = 0
+        self.bytes_total = 0
+        self.shipments = 0
+        self.nacks = 0
+        self.bootstraps = 0
+
+
+class SegmentShipper:
+    """Tail the leader WAL's synced watermark and stream the durable
+    prefix to attached followers.
+
+    ``wal`` is the leader's :class:`WriteAheadLog` (or ``None`` for a
+    cold log: pass ``wal_dir`` and the shipper treats the whole on-disk
+    prefix as shippable — useful for tools and tests). ``ckpt_dir``
+    enables checkpoint-anchored bootstrap for fresh followers.
+    ``leader_tick`` is a callable returning the leader's current tick
+    counter (piggybacked on shipments for lag gauges).
+
+    Drive it either with the background thread (``start()`` /
+    ``stop()``) or synchronously via :meth:`pump_once` (tests, benches
+    that want deterministic interleaving)."""
+
+    def __init__(self, wal=None, *, wal_dir: Optional[str] = None,
+                 ckpt_dir: Optional[str] = None,
+                 leader_tick: Optional[Callable[[], int]] = None,
+                 poll_s: float = 0.002,
+                 max_chunk_bytes: int = 1 << 20) -> None:
+        if wal is None and wal_dir is None:
+            raise ValueError("SegmentShipper needs a wal or a wal_dir")
+        self.wal = wal
+        self.wal_dir = wal_dir if wal_dir is not None else wal.wal_dir
+        self.ckpt_dir = ckpt_dir
+        self._leader_tick = leader_tick or (lambda: 0)
+        self.poll_s = poll_s
+        self.max_chunk_bytes = max(int(max_chunk_bytes), 1 << 10)
+        self._lock = threading.Lock()
+        self._followers: Dict[str, _FollowerState] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.bytes_total = 0
+        self.shipments = 0
+        self.nacks = 0
+        self.crc_stops = 0
+        self._metric_names: List[str] = []
+
+    # -- membership --------------------------------------------------------
+
+    def attach(self, follower, name: Optional[str] = None) -> str:
+        """Register ``follower`` and run the watermark handshake:
+        ``subscribe()`` for its persisted cursor, falling back to a
+        checkpoint-anchored ``bootstrap(ckpt_dir)`` (or the oldest
+        on-disk segment) for a fresh replica."""
+        name = name or getattr(follower, "name", None) \
+            or f"follower-{len(self._followers)}"
+        st = _FollowerState(name, follower)
+        cursor = follower.subscribe()
+        if cursor is None:
+            cursor = self._bootstrap(st)
+        st.cursor = LogPosition(*cursor)
+        with self._lock:
+            if name in self._followers:
+                raise ValueError(f"follower {name!r} already attached")
+            self._followers[name] = st
+        return name
+
+    def detach(self, name: str) -> None:
+        with self._lock:
+            self._followers.pop(name, None)
+
+    def _bootstrap(self, st: _FollowerState) -> Tuple[int, int]:
+        st.bootstraps += 1
+        if self.ckpt_dir is not None and os.path.exists(
+                os.path.join(self.ckpt_dir, "meta.pkl")):
+            return tuple(st.follower.bootstrap(self.ckpt_dir))
+        segs = list_segments(self.wal_dir)
+        first = segs[0][0] if segs else 0
+        return (first, len(_MAGIC))
+
+    # -- shipping ----------------------------------------------------------
+
+    def _horizon(self) -> LogPosition:
+        if self.wal is not None:
+            return self.wal.synced_position()
+        # cold log: everything on disk is the shippable prefix
+        segs = list_segments(self.wal_dir)
+        if not segs:
+            return LogPosition(0, len(_MAGIC))
+        seq, path = segs[-1]
+        return LogPosition(seq, os.path.getsize(path))
+
+    def pump_once(self) -> int:
+        """Ship every follower as far toward the current synced
+        watermark as one pass allows. Returns bytes shipped."""
+        horizon = self._horizon()
+        with self._lock:
+            states = list(self._followers.values())
+        shipped = 0
+        for st in states:
+            shipped += self._ship_follower(st, horizon)
+        if shipped or states:
+            self._persist_state(horizon)
+        return shipped
+
+    def _ship_follower(self, st: _FollowerState,
+                       horizon: LogPosition) -> int:
+        base = st.bytes_total
+        guard = 0
+        while st.cursor is not None and st.cursor < horizon:
+            guard += 1
+            if guard > 10_000:  # paranoia: never wedge the pump loop
+                break
+            if not self._ship_chunk(st, horizon):
+                break
+        return st.bytes_total - base
+
+    def _ship_chunk(self, st: _FollowerState,
+                    horizon: LogPosition) -> bool:
+        """Read, re-verify and send one chunk ``[cursor, ...)``; returns
+        False when this follower can make no more progress this pass."""
+        segs = dict(list_segments(self.wal_dir))
+        cur = st.cursor
+        if cur.segment not in segs:
+            # the leader truncated past this follower's cursor (a
+            # checkpoint retired those segments) — re-anchor on the
+            # checkpoint instead of a full refetch
+            st.cursor = LogPosition(*self._bootstrap(st))
+            return st.cursor != cur
+        sealed = cur.segment < horizon.segment
+        if sealed:
+            end = os.path.getsize(segs[cur.segment])
+        else:
+            end = horizon.offset
+        if end <= cur.offset:
+            if not sealed:
+                return False
+            # fully shipped sealed segment with no remaining frames to
+            # piggyback the seal on: the seal must still travel as a
+            # normal (empty) shipment — the receiver's cursor is the
+            # authoritative one, and a shipper-local hop would strand
+            # it at the old segment's end, NACK-rejecting every later
+            # chunk forever (cursor livelock)
+            payload = b""
+            chunk_end = cur.offset
+        else:
+            with open(segs[cur.segment], "rb") as f:
+                f.seek(cur.offset)
+                want = min(end - cur.offset, self.max_chunk_bytes)
+                data = f.read(want)
+            entries, valid, reason = iter_frames(data, cur.segment,
+                                                 cur.offset)
+            if valid < len(data) and len(data) < end - cur.offset:
+                # chunk boundary split a frame mid-air: ship the whole
+                # frames we have, the next chunk restarts at the boundary
+                reason = None
+            if valid == 0:
+                if reason is not None and sealed:
+                    raise WalError(
+                        f"wal-{cur.segment:08d}.log @ {cur.offset}: "
+                        f"{reason} in a sealed segment below the synced "
+                        f"watermark — real corruption, refusing to ship")
+                self.crc_stops += 1
+                return False
+            payload = data[:valid]
+            chunk_end = cur.offset + valid
+        seals = sealed and chunk_end == end
+        nxt = self._next_segment(segs, cur.segment) if seals else None
+        shipment = Shipment(cur.segment, cur.offset, payload, chunk_end,
+                            seals, nxt, self._leader_tick())
+        t0 = time.perf_counter()
+        resp = st.follower.receive(shipment)
+        if _trace.ENABLED:
+            _trace.evt("ship_segment", t0, time.perf_counter() - t0,
+                       track="wal-shipper",
+                       args={"follower": st.name,
+                             "segment": cur.segment,
+                             "offset": cur.offset,
+                             "bytes": len(payload),
+                             "seals": seals,
+                             "ack": isinstance(resp, ShipAck)})
+        if isinstance(resp, ShipAck):
+            st.cursor = LogPosition(*resp.cursor)
+            st.applied_horizon = resp.horizon
+            st.bytes_total += len(payload)
+            st.shipments += 1
+            self.bytes_total += len(payload)
+            self.shipments += 1
+            return True
+        # NACK: adopt the receiver's authoritative cursor and let the
+        # next pass re-read from disk (the WAL is the retransmit buffer)
+        st.nacks += 1
+        self.nacks += 1
+        if resp.cursor is not None:
+            st.cursor = LogPosition(*resp.cursor)
+        return False
+
+    @staticmethod
+    def _next_segment(segs: Dict[int, str], seq: int) -> int:
+        later = [s for s in segs if s > seq]
+        return min(later) if later else seq + 1
+
+    # -- backlog / state ---------------------------------------------------
+
+    def backlog_segments(self) -> int:
+        """How many segments the laggiest follower still has to fetch
+        (0 = everyone is inside the watermark segment)."""
+        horizon = self._horizon()
+        with self._lock:
+            cursors = [st.cursor for st in self._followers.values()
+                       if st.cursor is not None]
+        if not cursors:
+            return 0
+        return max(0, horizon.segment - min(c.segment for c in cursors))
+
+    def _persist_state(self, horizon: LogPosition) -> None:
+        with self._lock:
+            followers = {
+                st.name: {
+                    "shipped": list(st.cursor) if st.cursor else None,
+                    "applied_horizon": st.applied_horizon,
+                    "bytes_total": st.bytes_total,
+                    "shipments": st.shipments,
+                    "nacks": st.nacks,
+                    "bootstraps": st.bootstraps,
+                } for st in self._followers.values()}
+        state = {
+            "schema": SHIP_STATE_SCHEMA,
+            "horizon": list(horizon),
+            "leader_tick": self._leader_tick(),
+            "bytes_total": self.bytes_total,
+            "shipments": self.shipments,
+            "nacks": self.nacks,
+            "followers": followers,
+        }
+        path = os.path.join(self.wal_dir, SHIP_STATE_FILE)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(state, f, indent=2, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError:
+            pass  # tooling state only; never fail shipping over it
+
+    # -- thread loop -------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="wal-shipper", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                moved = self.pump_once()
+            except WalError:
+                raise
+            except Exception:  # noqa: BLE001 - a dying follower must
+                moved = 0      # not take the shipping loop with it
+            if not moved:
+                self._stop.wait(self.poll_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=10.0)
+
+    def close(self) -> None:
+        self.stop()
+        for name in self._metric_names:
+            REGISTRY.unregister_prefix(name)
+        self._metric_names.clear()
+
+    # -- observability -----------------------------------------------------
+
+    def publish_metrics(self, registry=None, name: str = "ship") -> None:
+        reg = registry if registry is not None else REGISTRY
+        reg.gauge(f"{name}.bytes_total", lambda: self.bytes_total)
+        reg.gauge(f"{name}.backlog_segments", self.backlog_segments)
+        reg.gauge(f"{name}.shipments", lambda: self.shipments)
+        reg.gauge(f"{name}.nacks", lambda: self.nacks)
+        reg.gauge(f"{name}.followers", lambda: len(self._followers))
+        self._metric_names.append(name)

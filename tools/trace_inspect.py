@@ -28,6 +28,13 @@ has two halves:
   decomposition deviation (stage sums are tiled, so this should sit at
   ~0%; large values mean a clock or export bug).
 
+A trace recorded under WAL shipping (``wal/ship.py`` +
+``serve/replica.py``) carries ``ship_segment`` spans on the
+``wal-shipper`` track and ``replica_replay`` spans on per-replica
+tracks; the report folds them into a **replication** section — per
+follower byte flow and NACKs, per replica applied records, replay time,
+and the published-horizon lag after each window.
+
 A trace recorded under a live ``ControlPlane`` also carries its
 actuations as zero-duration ``control.<action>`` spans on the
 ``control`` track; the report surfaces them as **control actions** —
@@ -79,6 +86,15 @@ def inspect(path: str) -> dict:
     # pump_execute spans carry args.depth (in-flight windows INCLUDING
     # the one being dispatched) — the occupancy histogram of the pipeline
     depth_counts: dict = defaultdict(int)
+    # WAL shipping / replica replay (wal/ship.py, serve/replica.py):
+    # ship_segment spans carry the per-follower byte flow, replica_replay
+    # spans the applied windows and the lag the replica published after
+    # each one — together the replica-lag breakdown
+    ship_by_follower: dict = defaultdict(
+        lambda: {"shipments": 0, "bytes": 0, "nacks": 0, "ship_ms": 0.0})
+    replay_by_replica: dict = defaultdict(
+        lambda: {"shipments": 0, "records_applied": 0, "replay_ms": 0.0,
+                 "horizon": 0, "lag_ticks": 0, "max_lag_ticks": 0})
     for ev in events:
         if ev.get("ph") == "X":
             by_name[ev.get("name", "?")].append(float(ev.get("dur", 0.0)))
@@ -96,6 +112,27 @@ def inspect(path: str) -> dict:
                 d = (ev.get("args") or {}).get("depth")
                 if d is not None:
                     depth_counts[int(d)] += 1
+            if ev.get("name") == "ship_segment":
+                a = ev.get("args") or {}
+                st = ship_by_follower[a.get("follower") or "?"]
+                st["shipments"] += 1
+                st["bytes"] += int(a.get("bytes", 0) or 0)
+                st["ship_ms"] += float(ev.get("dur", 0.0)) / 1e3
+                if not a.get("ack", True):
+                    st["nacks"] += 1
+            if ev.get("name") == "replica_replay":
+                a = ev.get("args") or {}
+                track = tid_names.get(ev.get("tid"), "replica/?")
+                name = track.split("/", 1)[1] if "/" in track else track
+                st = replay_by_replica[name]
+                st["shipments"] += 1
+                st["records_applied"] += int(a.get("applied", 0) or 0)
+                st["replay_ms"] += float(ev.get("dur", 0.0)) / 1e3
+                st["horizon"] = max(st["horizon"],
+                                    int(a.get("horizon", 0) or 0))
+                lag = int(a.get("lag_ticks", 0) or 0)
+                st["lag_ticks"] = lag
+                st["max_lag_ticks"] = max(st["max_lag_ticks"], lag)
             if ev.get("name") == "wal_fsync":
                 dur = float(ev.get("dur", 0.0))
                 if tid_names.get(ev.get("tid")) == "wal-committer":
@@ -165,6 +202,24 @@ def inspect(path: str) -> dict:
     stage_overlap_frac = (round(stage_overlapped / stage_total, 4)
                           if stage_total else 0.0)
     dispatch_by_depth = {str(d): n for d, n in sorted(depth_counts.items())}
+    replication = None
+    if ship_by_follower or replay_by_replica:
+        for st in ship_by_follower.values():
+            st["ship_ms"] = round(st["ship_ms"], 3)
+        for st in replay_by_replica.values():
+            st["replay_ms"] = round(st["replay_ms"], 3)
+        replication = {
+            "ship": {k: dict(v)
+                     for k, v in sorted(ship_by_follower.items())},
+            "replicas": {k: dict(v)
+                         for k, v in sorted(replay_by_replica.items())},
+            "max_lag_ticks": max(
+                (v["max_lag_ticks"] for v in replay_by_replica.values()),
+                default=0),
+            "final_lag_ticks": max(
+                (v["lag_ticks"] for v in replay_by_replica.values()),
+                default=0),
+        }
     return {
         "schema": "reflow.trace_inspect/1",
         "trace_file": path,
@@ -175,6 +230,7 @@ def inspect(path: str) -> dict:
         "stage_overlap_frac": stage_overlap_frac,
         "dispatch_by_depth": dispatch_by_depth,
         "per_device": per_device,
+        "replication": replication,
         "control_actions": control_actions,
         "spans": spans,
         "tickets": len(tickets),
@@ -217,6 +273,19 @@ def _print_human(s: dict) -> None:
         for dev, d in s["per_device"].items():
             print(f"{dev:<12} {d['dispatches']:>11} {d['busy_ms']:>10.2f} "
                   f"{100 * d['share']:>7.1f}%")
+    rep = s.get("replication")
+    if rep:
+        print(f"replication: max lag {rep['max_lag_ticks']} tick(s), "
+              f"final lag {rep['final_lag_ticks']} tick(s)")
+        for name, d in rep["replicas"].items():
+            print(f"  replica {name}: {d['shipments']} shipment(s) "
+                  f"{d['records_applied']} record(s) applied in "
+                  f"{d['replay_ms']:.2f}ms, horizon {d['horizon']}, "
+                  f"lag {d['lag_ticks']} (max {d['max_lag_ticks']})")
+        for name, d in rep["ship"].items():
+            print(f"  ship->{name}: {d['shipments']} shipment(s) "
+                  f"{d['bytes']} byte(s) in {d['ship_ms']:.2f}ms, "
+                  f"{d['nacks']} nack(s)")
     if s["control_actions"]:
         acts = ", ".join(f"{k}={v}"
                          for k, v in s["control_actions"].items())

@@ -101,6 +101,7 @@ def inspect(wal_dir: str, *, verbose: bool = True) -> dict:
     if run:
         push_runs.append(run)
     win = np.asarray(push_runs, dtype=float)
+    shipping = _ship_summary(wal_dir, per_seg)
     return {
         # same schema family as reflow_tpu.obs snapshots / trace_inspect
         "schema": "reflow.wal_inspect/1",
@@ -122,7 +123,48 @@ def inspect(wal_dir: str, *, verbose: bool = True) -> dict:
         "commit_window_p95_pushes": (
             float(np.percentile(win, 95)) if len(win) else 0.0),
         "segments_detail": [per_seg[s] for s in sorted(per_seg)],
+        "shipping": shipping,
         "torn_tail": torn._asdict() if torn is not None else None,
+    }
+
+
+def _ship_summary(wal_dir: str, per_seg: dict):
+    """Merge the shipper's persisted watermarks (wal/ship.py writes
+    ``ship-state.json`` next to the segments) into the summary and stamp
+    each segment's ship status: how many followers have fully fetched
+    it. None when this log has never been shipped."""
+    path = os.path.join(wal_dir, "ship-state.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            state = json.load(f)
+    except (OSError, ValueError) as e:
+        return {"error": f"unreadable ship-state.json: {e}"}
+    followers = state.get("followers", {})
+    cursors = [tuple(f["shipped"]) for f in followers.values()
+               if f.get("shipped")]
+    for seg in per_seg.values():
+        # a follower has the whole segment iff its cursor moved past it
+        seg["shipped_followers"] = sum(
+            1 for c in cursors if c[0] > seg["segment"]
+            or (c[0] == seg["segment"] and c[1] >= seg["bytes"]))
+        seg["shipped_fully"] = (len(cursors) > 0
+                                and seg["shipped_followers"] == len(cursors))
+    return {
+        "horizon": state.get("horizon"),
+        "leader_tick": state.get("leader_tick"),
+        "bytes_total": state.get("bytes_total"),
+        "shipments": state.get("shipments"),
+        "nacks": state.get("nacks"),
+        "followers": {
+            name: {"shipped": f.get("shipped"),
+                   "applied_horizon": f.get("applied_horizon"),
+                   "lag_ticks": max(0, (state.get("leader_tick") or 0)
+                                    - (f.get("applied_horizon") or 0)),
+                   "bytes_total": f.get("bytes_total"),
+                   "nacks": f.get("nacks")}
+            for name, f in followers.items()},
     }
 
 
@@ -156,11 +198,27 @@ def main(argv=None) -> int:
                   f"{summary['commit_windows']} commit window(s), "
                   f"largest {summary['commit_window_max_pushes']} "
                   f"push(es)")
+        ship = summary["shipping"]
         for seg in summary["segments_detail"]:
+            shipped = ""
+            if ship and "followers" in ship:
+                shipped = (f" shipped={seg.get('shipped_followers', 0)}/"
+                           f"{len(ship['followers'])} follower(s)")
             print(f"segment {seg['segment']:08d}: {seg['bytes']:>8} bytes "
                   f"{seg['records']:>5} record(s) {seg['pushes']:>5} "
                   f"push(es) {seg['rows']:>7} row(s) "
-                  f"{seg['micro_batches']:>5} micro-batch(es)")
+                  f"{seg['micro_batches']:>5} micro-batch(es){shipped}")
+        if ship and "followers" in ship:
+            print(f"shipping: horizon={tuple(ship['horizon'])} "
+                  f"leader_tick={ship['leader_tick']} "
+                  f"bytes_total={ship['bytes_total']} "
+                  f"nacks={ship['nacks']}")
+            for fname, f in sorted(ship["followers"].items()):
+                print(f"  follower {fname}: shipped="
+                      f"{tuple(f['shipped']) if f['shipped'] else None} "
+                      f"applied_horizon={f['applied_horizon']} "
+                      f"lag_ticks={f['lag_ticks']} "
+                      f"bytes={f['bytes_total']} nacks={f['nacks']}")
         if torn:
             print(f"torn tail (tolerated): segment {torn['segment']} @ "
                   f"{torn['offset']}: {torn['reason']}")
