@@ -128,6 +128,19 @@ Env knobs::
                                   the published horizon (CPU-only)
     REFLOW_BENCH_REPLICA_N        follower count            (default 4)
     REFLOW_BENCH_REPLICA_READ_S   per-leg read window (s)   (default 2.0)
+    REFLOW_BENCH_FAILOVER=1       failover mode instead: kill the leader
+                                  (committer crash seam) under sustained
+                                  16-producer writes; a
+                                  FailoverCoordinator detects, fences the
+                                  old epoch, elects + promotes a replica
+                                  and re-binds ingestion; reports
+                                  detection/promotion/first-window walls,
+                                  asserts ZERO acked-write loss (final
+                                  view == a fold of every acked batch)
+                                  and exact old-vs-new view parity at the
+                                  promotion horizon (CPU-only)
+    REFLOW_BENCH_FAILOVER_N       follower count            (default 2)
+    REFLOW_BENCH_FAILOVER_RUN_S   per-phase write window (s) (default 1.0)
     REFLOW_TRACE_OUT              obs-mode chrome trace path
                                   (default /tmp/reflow_obs_trace.json)
 
@@ -1294,6 +1307,254 @@ def run_replica_bench() -> dict:
     return out
 
 
+# -- leader-failover mode (REFLOW_BENCH_FAILOVER=1) ------------------------
+
+def run_failover_bench() -> dict:
+    """Promote-on-failure under load (docs/guide.md "Leader failover"):
+    a wordcount leader (``DurableScheduler`` + ``IngestFrontend``) under
+    sustained 16-producer writes with a ``SegmentShipper`` feeding N
+    replicas — then the leader is killed mid-stream (a crash seam inside
+    the WAL committer: the fsync raises, the committer dies, the pump
+    crashes on its next window) and a ``FailoverCoordinator`` runs the
+    whole failover: detect → final drain → fence → elect → promote →
+    re-ship → re-point reads and ingestion.
+
+    Producers use FIXED batch ids and a resubmit-until-acked loop: a
+    ticket that dies with ``PumpCrashed`` is resubmitted with the same
+    id after the rebind, so the WAL dedup — not the producer — decides
+    exactly-once. The bench reports:
+
+    - **detection_s / promotion_s / first_window_s**: kill → the
+      coordinator confirms death; the promotion step's wall; promotion
+      → the first commit window applied on the new leader;
+    - **zero acked-write loss**: the new leader's final view exactly
+      equals a fresh fold of every batch any producer got an ack for
+      (applied or deduped) — acked ⊆ synced ⊆ shipped-after-drain;
+    - **old-vs-new parity at the promotion horizon**: captured inside
+      the promotion callback, before any new-epoch write lands.
+
+    Host-side CPU work; runs on the CPU executor/platform."""
+    import shutil
+    import tempfile
+    import threading
+
+    from reflow_tpu.obs import REGISTRY
+    from reflow_tpu.serve import (CoalesceWindow, FailoverCoordinator,
+                                  IngestFrontend, LeaderReadAdapter,
+                                  ReadTier, ReplicaScheduler)
+    from reflow_tpu.utils.faults import CrashInjector
+    from reflow_tpu.wal import DurableScheduler, FencedWrite, SegmentShipper
+    from reflow_tpu.workloads import wordcount
+
+    smoke = os.environ.get("REFLOW_BENCH_SMOKE") == "1"
+    n_replicas = int(os.environ.get("REFLOW_BENCH_FAILOVER_N", "2"))
+    n_producers = 16
+    window_ticks = 4
+    vocab = 2_000 if smoke else 20_000
+    run_s = float(os.environ.get(
+        "REFLOW_BENCH_FAILOVER_RUN_S", "0.3" if smoke else "1.0"))
+
+    tmp = tempfile.mkdtemp(prefix="reflow-failover-")
+    out = {"replicas": n_replicas, "producers": n_producers,
+           "window_ticks": window_ticks, "run_s": run_s, "vocab": vocab}
+    fe = ship = coord = new_sched = None
+    replicas = []
+    try:
+        g, src, sink = wordcount.build_graph()
+        sched = DurableScheduler(g, wal_dir=os.path.join(tmp, "wal"),
+                                 fsync="tick", committer="thread",
+                                 segment_bytes=1 << 20)
+        fe = IngestFrontend(sched, window=CoalesceWindow(
+            max_rows=65536, max_ticks=window_ticks, max_latency_s=0.002))
+        ship = SegmentShipper(sched.wal, leader_tick=lambda: sched._tick,
+                              poll_s=0.001)
+        for i in range(n_replicas):
+            gr, _s, _k = wordcount.build_graph()
+            r = ReplicaScheduler(gr, os.path.join(tmp, f"r{i}"),
+                                 name=f"r{i}")
+            ship.attach(r)
+            replicas.append(r)
+        tier = ReadTier(replicas, leader=LeaderReadAdapter(sched))
+        ship.start()
+
+        # old-vs-new parity at the promotion horizon, captured INSIDE
+        # the promotion (before any new-epoch write can land)
+        parity = {}
+
+        def promote_fn(winner, epoch):
+            # the winner's published view IS the old leader's durable
+            # prefix at the promotion horizon (mirrored bytes, replayed
+            # through the same machinery) — the new leader must equal
+            # it exactly. The old leader's live in-memory view may be
+            # ahead by its final un-synced (never-acked) window; that
+            # overhang is reported, not an error.
+            ph, pre = winner.view_at(sink.name)
+            ns = winner.promote(epoch=epoch, fsync="tick",
+                                committer="thread")
+            new_view = {kv: w for kv, w in ns.view(sink.name).items()
+                        if w != 0}
+            diff = 0
+            for kv in set(pre) | set(new_view):
+                diff = max(diff, abs(pre.get(kv, 0)
+                                     - new_view.get(kv, 0)))
+            parity.update(horizon=ph, old_ticks=sched._tick,
+                          overhang_ticks=sched._tick - ph,
+                          max_abs_diff=diff)
+            return ns
+
+        coord = FailoverCoordinator(
+            replicas, shipper=ship, handle=fe, read_tier=tier,
+            confirm_intervals=2, promote_fn=promote_fn)
+        coord.publish_metrics()
+
+        # -- sustained writes with fixed ids + resubmit-until-acked
+        stop = threading.Event()
+        rebound = threading.Event()
+        acked_lock = threading.Lock()
+        acked: list = []   # (batch_id, words) with a terminal ack
+        lost = [0]         # batches given up on (must stay 0)
+
+        def produce(pid):
+            rng = np.random.default_rng(1000 + pid)
+            seq = 0
+            while not stop.is_set():
+                words = " ".join(
+                    f"w{int(x)}" for x in rng.integers(0, vocab, 24))
+                bid = f"p{pid}-{seq}"
+                batch = wordcount.ingest_lines([words])
+                deadline = time.monotonic() + 60
+                ok = False
+                while time.monotonic() < deadline:
+                    try:
+                        res = fe.submit(src, batch,
+                                        batch_id=bid).result(timeout=60)
+                    except Exception:  # noqa: BLE001 - PumpCrashed /
+                        # FrontendClosed mid-failover: wait out the
+                        # rebind, then resubmit the SAME id — the WAL
+                        # dedup decides exactly-once, not this loop
+                        rebound.wait(timeout=30)
+                        time.sleep(0.002)
+                        continue
+                    if res.status in ("applied", "deduped"):
+                        ok = True
+                        break
+                    time.sleep(0.001)
+                if ok:
+                    with acked_lock:
+                        acked.append((bid, words))
+                else:
+                    lost[0] += 1
+                seq += 1
+
+        producers = [threading.Thread(target=produce, args=(pid,))
+                     for pid in range(n_producers)]
+        for t in producers:
+            t.start()
+        time.sleep(run_s)
+
+        # -- kill the leader: the committer's next fsync dies
+        sched.wal._crash = CrashInjector(at=1, only="wal_before_fsync")
+        t_kill = time.perf_counter()
+        log(f"failover: leader killed at tick {sched._tick}")
+
+        t_detect = t_promoted = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            t0 = time.perf_counter()
+            acts = coord.step()
+            if any(a["kind"] == "failover_promote" for a in acts):
+                t_detect, t_promoted = t0, time.perf_counter()
+            if coord.promoted and not coord._pending_rebind:
+                break
+            time.sleep(0.002)
+        assert coord.promoted, "failover never fired"
+        rebound.set()
+        new_sched = coord.leader_sched
+        out["detection_s"] = round(t_detect - t_kill, 4)
+        out["promotion_s"] = round(t_promoted - t_detect, 4)
+        out["winner"] = coord.winner.name
+        out["epoch"] = coord.epoch
+        out["drained_bytes"] = coord.drained_bytes
+
+        # first commit window on the new leader, through the SAME
+        # frontend handle the producers are already using
+        probe = fe.submit(src, wordcount.ingest_lines(["probe words"]),
+                          batch_id="probe-1")
+        probe.result(timeout=60)
+        out["first_window_s"] = round(time.perf_counter() - t_promoted, 4)
+        with acked_lock:
+            acked.append(("probe-1", "probe words"))
+        log(f"failover: {out['winner']} promoted to epoch "
+            f"{out['epoch']} — detect {out['detection_s']}s, promote "
+            f"{out['promotion_s']}s, first window "
+            f"{out['first_window_s']}s")
+
+        # reads survived the swing: the tier now falls back to the new
+        # leader for fresh horizons
+        res = tier.top_k(sink.name, 10, min_horizon=new_sched._tick,
+                         by="value")
+        out["post_failover_read_source"] = res.source
+
+        time.sleep(run_s)  # keep writing on the new leader
+        stop.set()
+        for t in producers:
+            t.join()
+        fe.flush()
+        new_sched.wal.sync()
+
+        # the zombie is fenced: its log refuses appends, counted
+        try:
+            sched.wal.append({"kind": "tick", "tick": 10 ** 9})
+            assert False, "zombie append was accepted"
+        except FencedWrite:
+            pass
+        out["fence_rejected_appends"] = sched.wal.fence_rejected_appends
+
+        # -- zero acked-write loss: every acked batch folded exactly once
+        assert lost[0] == 0, f"{lost[0]} producer batch(es) gave up"
+        from reflow_tpu.scheduler import DirtyScheduler
+        go, so, ko = wordcount.build_graph()
+        oracle = DirtyScheduler(go)
+        with acked_lock:
+            for bid, words in acked:
+                oracle.push(so, wordcount.ingest_lines([words]),
+                            batch_id=bid)
+        oracle.tick()
+        want = {kv: w for kv, w in oracle.view(ko.name).items() if w != 0}
+        got = {kv: w for kv, w in new_sched.view(sink.name).items()
+               if w != 0}
+        diff = 0
+        for kv in set(want) | set(got):
+            diff = max(diff, abs(want.get(kv, 0) - got.get(kv, 0)))
+        out["acked_batches"] = len(acked)
+        out["acked_loss_max_abs_diff"] = diff
+        assert diff == 0, f"acked-write loss: max_abs_diff={diff}"
+
+        out["promotion_horizon"] = parity.get("horizon")
+        out["promotion_overhang_ticks"] = parity.get("overhang_ticks")
+        out["promotion_parity_max_abs_diff"] = parity.get("max_abs_diff")
+        assert parity.get("max_abs_diff") == 0
+        out["epoch_gauge"] = REGISTRY.value("failover.epoch", -1)
+        out["new_leader_ticks"] = new_sched._tick
+        log(f"failover: {len(acked)} acked batch(es), zero loss "
+            f"(diff {diff}), promotion parity diff "
+            f"{parity.get('max_abs_diff')} at horizon "
+            f"{parity.get('horizon')}")
+    finally:
+        if fe is not None:
+            fe.close()
+        if coord is not None:
+            coord.close()
+        if ship is not None:
+            ship.close()
+        for r in replicas:
+            r.close()
+        if new_sched is not None:
+            new_sched.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 # -- tier / multi-graph serving mode (REFLOW_BENCH_TIER=1) -----------------
 
 def run_tier_bench() -> dict:
@@ -2357,6 +2618,18 @@ def main() -> None:
             "metric": "replica_read_scaling_x",
             "value": out["read_scaling_x"],
             "unit": "x",
+            **out,
+        }, json_out)
+        return
+
+    if os.environ.get("REFLOW_BENCH_FAILOVER") == "1":
+        # failover mode is host-side CPU work — no tunnel, no subprocesses
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        out = run_failover_bench()
+        _emit({
+            "metric": "failover_promotion_s",
+            "value": out["promotion_s"],
+            "unit": "s",
             **out,
         }, json_out)
         return

@@ -16,6 +16,8 @@ from .coalesce import CoalesceWindow, Feed, build_feeds
 from .control import (Autoscaler, BrownoutLadder, CircuitBreaker,
                       ControlConfig, ControlPlane, SLOSpec,
                       load_slo_specs)
+from .failover import (ElectionPolicy, FailoverCoordinator,
+                       HighestHorizonElection)
 from .frontend import IngestFrontend
 from .queues import batch_nbytes
 from .read import LeaderReadAdapter, ReadResult, ReadTier, StaleRead
@@ -28,7 +30,8 @@ __all__ = [
     "APPLIED", "DEDUPED", "REJECTED", "SHED",
     "AdmissionBudget", "Autoscaler", "BrownoutLadder", "BudgetShare",
     "CircuitBreaker", "CoalesceWindow", "ControlConfig", "ControlPlane",
-    "Feed", "FrontendClosed", "GraphConfig", "GraphHandle",
+    "ElectionPolicy", "FailoverCoordinator", "Feed", "FrontendClosed",
+    "GraphConfig", "GraphHandle", "HighestHorizonElection",
     "IngestFrontend", "LeaderReadAdapter", "PumpCrashed", "ReadResult",
     "ReadTier", "ReplicaScheduler", "SLOSpec", "ServeTier", "StaleRead",
     "Ticket", "TicketResult", "batch_nbytes", "build_feeds", "dwrr_pick",

@@ -482,9 +482,14 @@ class ControlPlane:
                  config_path: Optional[str] = None, registry=None,
                  clock: Callable[[], float] = time.monotonic,
                  rng: Optional[Callable[[], float]] = None,
-                 sampler: Optional[Callable[[float], Dict]] = None):
+                 sampler: Optional[Callable[[float], Dict]] = None,
+                 failover=None):
         from reflow_tpu.obs import REGISTRY
         self.tier = tier
+        #: optional serve.failover.FailoverCoordinator, stepped on the
+        #: control interval — leader-death detection and promotion ride
+        #: the same supervision loop as the other actuators
+        self.failover = failover
         # file first, explicit specs= override per graph — an operator
         # config sets the fleet default, code pins the exceptions
         self.specs = (dict(load_slo_specs(config_path))
@@ -603,6 +608,8 @@ class ControlPlane:
             self._step_supervision(now, name, ctl, info, actions)
             self._step_reclaim(now, name, ctl, info, actions)
         self._step_pool(now, sample, actions)
+        if self.failover is not None:
+            actions.extend(self.failover.step(now))
         for a in actions:
             self._record(a)
         return actions

@@ -1017,7 +1017,27 @@ class IngestFrontend:
                 if not e.ticket.done():
                     e.ticket._fail(crash)
 
-    def revive(self) -> None:
+    def _bind_sched(self, sched) -> None:
+        """Re-point a settled frontend at a new scheduler (the failover
+        path; caller holds the lock, state is ``"failed"``). The dedup
+        mirror is REBUILT from the new scheduler's recovered window:
+        a batch the old leader committed *and shipped* dedups here,
+        while a batch only the dead leader ever saw is dropped from the
+        mirror — its ticket failed with ``PumpCrashed``, the producer's
+        resubmit is admitted, and it folds exactly once on the new
+        leader."""
+        self.sched = sched
+        self._cursors.clear()  # auto-id cursors re-derive from new sched
+        self._admitted = dict.fromkeys(sched._seen_batch_ids)
+        self.megatick = bool(getattr(sched, "window_support", False))
+        if not self.megatick and self.admission == "device":
+            self.admission = "host"
+        staged = (self.megatick
+                  and getattr(sched, "stage_window", None) is not None)
+        if not staged:
+            self.depth = 1
+
+    def revive(self, sched=None) -> None:
         """Re-arm a failed frontend: ``"failed"`` → ``"running"`` — the
         control plane's respawn actuator (callers can also use it by
         hand). Only valid after :meth:`_on_pump_crash` settled the
@@ -1028,18 +1048,27 @@ class IngestFrontend:
         :class:`PumpCrashed`; a durable graph's replay dedups any that
         actually executed.
 
+        ``sched=`` re-points the frontend at a NEW scheduler before
+        re-arming — the failover path: after a leader dies and a
+        replica promotes, the tier revives the same frontend over the
+        promoted ``DurableScheduler`` so producers keep their handle
+        and resubmit through the (rebuilt) dedup mirror.
+
         Durability caveat: reviving is at-most-once for the CRASHED
         window on a volatile graph (its deltas are gone); a durable
         graph loses nothing acknowledged — unacknowledged batches are
         the upstream's to re-send, same as process-crash recovery. If
         the scheduler's WAL committer is dead this raises — call
-        ``wal.restart_committer()`` first, or the next window would
-        fail the graph right back."""
+        ``wal.restart_committer()`` first (or pass the promoted
+        ``sched=``), or the next window would fail the graph right
+        back."""
         with self._lock:
             if self._state != "failed":
                 raise GraphError(
                     f"revive() re-arms a failed frontend; state is "
                     f"{self._state!r}")
+            if sched is not None and sched is not self.sched:
+                self._bind_sched(sched)
             wal = getattr(self.sched, "wal", None)
             if wal is not None and wal.committer_error is not None:
                 raise GraphError(

@@ -135,9 +135,21 @@ class ReadTier:
         with self._lock:
             return list(self._replicas)
 
-    def promote(self, replica) -> None:
-        """Failover stub (control-plane actuator, later PR)."""
-        replica.promote()
+    def promote(self, replica, *, epoch: Optional[int] = None,
+                **durable_kw):
+        """Failover re-point: promote ``replica`` to leader (idempotent
+        — an already-promoted replica hands back its scheduler), drop it
+        from the read rotation (its snapshots stop advancing as a
+        follower's would) and swing the leader fallback to a
+        :class:`LeaderReadAdapter` over the new leader. Returns the new
+        leader scheduler so the caller (normally
+        ``serve.failover.FailoverCoordinator``) can re-bind ingestion
+        and shipping too."""
+        sched = replica.promote(epoch=epoch, **durable_kw)
+        self.remove_replica(replica)
+        with self._lock:
+            self.leader = LeaderReadAdapter(sched)
+        return sched
 
     # -- routing -----------------------------------------------------------
 

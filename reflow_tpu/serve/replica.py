@@ -28,8 +28,16 @@ Three invariants carry the design:
   numpy buffers — reads scale with replica count instead of serializing
   on the leader's live, mutable views.
 
-``promote()`` is a stub: failover is a control-plane actuator for a
-later PR; replicas currently serve reads only.
+``promote()`` turns a follower into a leader: the staged (unapplied)
+tail is truncated out of the mirror, a ``DurableScheduler`` opens the
+mirror directory as its own WAL in a **new epoch**, and ``recover()``
+replays the mirrored prefix — so the new leader's state is exactly the
+replica's published horizon, rebuilt through the same machinery crash
+recovery trusts. Shipments from an older epoch are NACKed with a
+``fenced`` reason and never mirrored; ``reanchor()`` is the surviving
+followers' half of a failover (drop holdback, truncate to the apply
+point, adopt the new epoch, re-subscribe). The election and serving
+re-bind live in ``serve/failover.py``.
 """
 
 from __future__ import annotations
@@ -104,11 +112,16 @@ class ReplicaScheduler:
         self._horizon = 0
         self._leader_tick = 0
         self._snapshots: Dict[str, _Snapshot] = {}
+        #: highest epoch witnessed (shipment header or mirrored record);
+        #: shipments below it are fenced out before a byte is mirrored
+        self._epoch = 0
+        self._promoted_sched = None
         self.shipments = 0
         self.records_applied = 0
         self.windows_applied = 0
         self.crc_rejects = 0
         self.order_rejects = 0
+        self.fence_rejected_shipments = 0
         self.bootstraps = 0
         self.restored_from: Optional[str] = None
         self._metric_names: List[str] = []
@@ -153,6 +166,24 @@ class ReplicaScheduler:
         t0 = time.perf_counter()
         with self._lock:
             self.shipments += 1
+            ep = getattr(sh, "epoch", 0)
+            if ep < self._epoch:
+                # a zombie ex-leader kept shipping: refuse before a
+                # single byte is mirrored or staged
+                self.fence_rejected_shipments += 1
+                if _trace.ENABLED:
+                    _trace.evt("fence_reject", t0,
+                               time.perf_counter() - t0,
+                               track=f"replica/{self.name}",
+                               args={"kind": "shipment", "epoch": ep,
+                                     "fenced_by": self._epoch,
+                                     "segment": sh.segment})
+                return ShipNack(
+                    tuple(self._cursor) if self._cursor else None,
+                    f"fenced: shipment epoch {ep} < replica epoch "
+                    f"{self._epoch}")
+            if ep > self._epoch:
+                self._epoch = ep
             cur = self._cursor
             if cur is None:
                 # an unanchored fresh replica may only start at a
@@ -317,6 +348,10 @@ class ReplicaScheduler:
             entries, _valid, _reason = iter_frames(
                 data[len(_MAGIC):], seq, len(_MAGIC))
             for p, e, r in entries:
+                # mirrored records carry their writer's epoch: a restart
+                # resumes already knowing the highest epoch it witnessed,
+                # so a zombie's shipments stay fenced across restarts
+                self._epoch = max(self._epoch, r.get("epoch", 0) or 0)
                 if start is not None and p.segment == start[0] \
                         and p.offset < start[1]:
                     continue
@@ -414,14 +449,94 @@ class ReplicaScheduler:
         snap = self._snapshot(sink)
         return max(snap.horizon, 0), dict(snap.index)
 
-    # -- lifecycle / observability -----------------------------------------
+    # -- failover ----------------------------------------------------------
 
-    def promote(self):
-        """Failover actuator stub: a later PR wires the control plane to
-        re-point ingestion at a promoted replica; today replicas serve
-        reads only."""
-        raise NotImplementedError(
-            "promote-on-failure is a control-plane actuator stub")
+    @property
+    def epoch(self) -> int:
+        """Highest epoch this replica has witnessed."""
+        return self._epoch
+
+    @property
+    def promoted(self) -> bool:
+        return self._promoted_sched is not None
+
+    def _truncate_mirror_to_applied(self) -> None:
+        """Drop every mirrored byte past the apply point: segments
+        beyond it are deleted, the apply-point segment is cut at its
+        offset. With ``_applied`` None (nothing ever applied) the whole
+        mirror goes — the shipper re-bootstraps."""
+        pos = self._applied
+        for seq, path in list_segments(self.mirror_dir):
+            if pos is None or seq > pos.segment:
+                os.remove(path)
+            elif seq == pos.segment:
+                with open(path, "rb+") as f:
+                    f.truncate(pos.offset)
+
+    def promote(self, *, epoch: Optional[int] = None, **durable_kw):
+        """Promote this follower to leader. The staged (held-back) tail
+        is truncated out of the mirror — a partial commit window never
+        survives a failover — then a :class:`DurableScheduler` opens the
+        mirror directory as its own WAL in the new epoch (a fresh
+        segment; segments are never resumed) and ``recover()`` replays
+        the mirrored prefix through the replica's checkpoint. Returns
+        the new leader scheduler; idempotent (a second call returns the
+        same scheduler). ``durable_kw`` forwards to
+        ``DurableScheduler`` (``fsync=``, ``committer=``, ...)."""
+        from reflow_tpu.wal.durable import DurableScheduler
+        from reflow_tpu.wal.recovery import recover
+
+        t0 = time.perf_counter()
+        with self._lock:
+            if self._promoted_sched is not None:
+                return self._promoted_sched
+            new_epoch = int(epoch) if epoch is not None \
+                else self._epoch + 1
+            if new_epoch <= self._epoch and epoch is not None:
+                raise WalError(
+                    f"promote epoch {new_epoch} must exceed the "
+                    f"replica's witnessed epoch {self._epoch}")
+            self._staged.clear()
+            self._truncate_mirror_to_applied()
+            self._cursor = self._applied
+            # the promotion horizon: what this replica had applied when
+            # it won the election — the new leader's state is exactly it
+            horizon = self._horizon
+            sched = DurableScheduler(
+                self.graph, wal_dir=self.mirror_dir,
+                epoch=new_epoch, **durable_kw)
+            report = recover(sched, self.mirror_dir, self.ckpt_dir)
+            self._epoch = new_epoch
+            self._promoted_sched = sched
+            self._persist_cursor()
+        if _trace.ENABLED:
+            _trace.evt("failover_replay", t0, time.perf_counter() - t0,
+                       track=f"replica/{self.name}",
+                       args={"epoch": new_epoch, "horizon": horizon,
+                             "replayed_pushes": report.replayed_pushes,
+                             "replayed_ticks": report.replayed_ticks,
+                             "final_tick": report.final_tick})
+        return sched
+
+    def reanchor(self, epoch: int) -> Optional[Tuple[int, int]]:
+        """The surviving followers' half of a failover: drop the
+        holdback buffer, truncate the mirror back to the apply point
+        (bytes past it may diverge from the new leader's log), adopt the
+        new epoch and return the re-anchored cursor — ready for a fresh
+        ``shipper.attach``. Applied state is untouched: the apply point
+        is always at or below the promotion horizon, so the new leader's
+        log extends it byte-identically."""
+        with self._lock:
+            self._staged.clear()
+            self._truncate_mirror_to_applied()
+            self._cursor = self._applied
+            if epoch > self._epoch:
+                self._epoch = epoch
+            self._persist_cursor()
+            return tuple(self._cursor) if self._cursor is not None \
+                else None
+
+    # -- lifecycle / observability -----------------------------------------
 
     def publish_metrics(self, registry=None,
                         name: Optional[str] = None) -> None:
@@ -433,6 +548,9 @@ class ReplicaScheduler:
                   lambda: self.records_applied)
         reg.gauge(f"{base}.crc_rejects", lambda: self.crc_rejects)
         reg.gauge(f"{base}.staged_records", lambda: len(self._staged))
+        reg.gauge(f"{base}.epoch", lambda: self._epoch)
+        reg.gauge(f"{base}.fence_rejected_shipments",
+                  lambda: self.fence_rejected_shipments)
         self._metric_names.append(base)
 
     def close(self) -> None:

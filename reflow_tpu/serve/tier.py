@@ -179,6 +179,16 @@ class GraphHandle:
     def drain(self, source=None, **kw) -> int:
         return self.frontend.drain(source, **kw)
 
+    def rebind(self, sched) -> None:
+        """Failover re-point: revive this graph's (crashed) frontend
+        over a NEW scheduler — normally a promoted replica's
+        ``DurableScheduler``. The graph stays registered, producers keep
+        this handle, and resubmissions of batches the dead leader never
+        committed are re-admitted through the rebuilt dedup mirror
+        (see ``IngestFrontend.revive``). The old scheduler is left to
+        its owner — a fenced zombie may still be flailing at it."""
+        self.frontend.revive(sched=sched)
+
     def __repr__(self) -> str:
         return (f"GraphHandle({self.name!r}, weight={self.config.weight}, "
                 f"state={self.frontend._state!r})")

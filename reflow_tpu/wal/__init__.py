@@ -13,14 +13,15 @@ torn tail writes, and crashes between ``push`` and ``tick``.
 """
 
 from reflow_tpu.wal.durable import DurableScheduler
-from reflow_tpu.wal.log import (LogPosition, WalError, WriteAheadLog,
-                                scan_wal)
+from reflow_tpu.wal.log import (FencedWrite, LogPosition, WalError,
+                                WriteAheadLog, scan_wal)
 from reflow_tpu.wal.recovery import RecoveryReport, recover, replay_records
 from reflow_tpu.wal.ship import (SegmentShipper, ShipAck, Shipment,
                                  ShipNack)
 
 __all__ = [
     "DurableScheduler",
+    "FencedWrite",
     "LogPosition",
     "RecoveryReport",
     "SegmentShipper",

@@ -75,11 +75,13 @@ class DurableScheduler(DirtyScheduler):
 
     def __init__(self, graph, executor=None, *, wal_dir: str,
                  fsync: str = "tick", segment_bytes: int = 16 << 20,
-                 committer: str = "thread", crash=None, **kwargs):
+                 committer: str = "thread", crash=None, epoch: int = 0,
+                 **kwargs):
         super().__init__(graph, executor, **kwargs)
         self.wal = WriteAheadLog(wal_dir, fsync=fsync,
                                  segment_bytes=segment_bytes,
-                                 committer=committer, crash=crash)
+                                 committer=committer, crash=crash,
+                                 epoch=epoch)
         self._crash = crash
         self._wal_suspended = False  # recovery replay must not re-log
         self._auto_seq = 0

@@ -83,8 +83,8 @@ from typing import (Callable, Deque, Dict, Iterable, List, NamedTuple,
 
 from reflow_tpu.obs import trace as _trace
 
-__all__ = ["LogPosition", "TornTail", "WalError", "WriteAheadLog",
-           "list_segments", "scan_wal"]
+__all__ = ["FencedWrite", "LogPosition", "TornTail", "WalError",
+           "WriteAheadLog", "list_segments", "scan_wal"]
 
 _MAGIC = b"RFWAL001"
 _HEADER = struct.Struct("<II")  # payload_len, crc32
@@ -99,6 +99,21 @@ _METRIC_WINDOW = 4096
 
 class WalError(RuntimeError):
     """Corruption in a sealed (non-tail) region of the log."""
+
+
+class FencedWrite(WalError):
+    """A write was refused because this log's epoch has been fenced: a
+    newer leader epoch was minted at promotion (``wal/ship.py`` /
+    ``serve/failover.py``), so this writer is a zombie ex-leader. Its
+    appends must never reach the replicated history — they are rejected
+    here, and the epoch stamped into every record lets receivers reject
+    anything that slipped onto disk before the fence landed."""
+
+
+#: on-disk sidecar recording the log's epoch + fence state so offline
+#: tooling (tools/wal_inspect.py) can report it after the process died
+FENCE_STATE_SCHEMA = "reflow.wal_fence/1"
+_FENCE_STATE_FILE = "fence-state.json"
 
 
 class LogPosition(NamedTuple):
@@ -170,7 +185,7 @@ class WriteAheadLog:
 
     def __init__(self, wal_dir: str, *, fsync: str = "tick",
                  segment_bytes: int = 16 << 20,
-                 committer: str = "thread", crash=None):
+                 committer: str = "thread", crash=None, epoch: int = 0):
         if fsync not in self.POLICIES:
             raise ValueError(f"fsync policy {fsync!r} not in {self.POLICIES}")
         if committer not in self.COMMITTERS:
@@ -180,7 +195,29 @@ class WriteAheadLog:
         self.fsync_policy = fsync
         self.segment_bytes = segment_bytes
         self._crash = crash
+        #: leader-epoch token stamped into every appended record (and
+        #: into the shipper's Shipments): minted at promotion, so a
+        #: receiver can tell a live leader's bytes from a zombie's
+        self._epoch = int(epoch)
+        #: the newer epoch that fenced this log (None = not fenced)
+        self._fenced_by: Optional[int] = None
+        #: appends refused because the log was fenced (zombie writer)
+        self.fence_rejected_appends = 0
         os.makedirs(wal_dir, exist_ok=True)
+        # a fenced log STAYS fenced across restarts: a zombie that
+        # crashes and reopens its old directory must not come back
+        # writable (the sidecar is best-effort, but so is the zombie's
+        # luck — replicas reject its shipments by epoch regardless)
+        try:
+            import json
+            with open(os.path.join(wal_dir, _FENCE_STATE_FILE)) as f:
+                saved = json.load(f)
+            self._epoch = max(self._epoch, int(saved.get("epoch") or 0))
+            fb = saved.get("fenced_by")
+            if fb is not None and int(fb) > self._epoch:
+                self._fenced_by = int(fb)
+        except (OSError, ValueError):
+            pass
         segs = list_segments(wal_dir)
         #: torn tail repaired at open, if any (surfaced by recovery)
         self.repaired_tail: Optional[TornTail] = None
@@ -254,6 +291,8 @@ class WriteAheadLog:
         self.committer_restarts = 0
         self.last_committer_error: Optional[BaseException] = None
         self._open_segment()
+        if self._epoch:
+            self._persist_fence_locked()
         #: highest segment seq the committer has finished opening
         #: (thread-mode rotate() barrier)
         self._rotated_seq = self._seq
@@ -279,6 +318,16 @@ class WriteAheadLog:
         self._offset = len(_MAGIC)
 
     def _frame(self, record: Dict) -> bytes:
+        # records from a promoted leader carry its epoch: receivers
+        # (replicas, recovery) can reject/attribute bytes by leader
+        # generation even when they arrived on disk before a fence
+        # landed. The binary frame layout is unchanged — the token
+        # rides in the pickled dict — and epoch 0 (the founding
+        # leader) stays UNstamped, so its bytes are identical to a
+        # pre-failover log's (an absent key reads as epoch 0
+        # everywhere).
+        if self._epoch and record.get("epoch") != self._epoch:
+            record = {**record, "epoch": self._epoch}
         payload = pickle.dumps(record, protocol=pickle.HIGHEST_PROTOCOL)
         return _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
 
@@ -358,6 +407,7 @@ class WriteAheadLog:
         with :meth:`last_lsn`. ``"tick"`` batches the fsync into
         :meth:`note_tick`."""
         with self._lock:
+            self._raise_if_fenced()
             self._raise_if_committer_dead()
             pos, lsn = self._append_frame(record)
             if self.fsync_policy == "record":
@@ -384,6 +434,7 @@ class WriteAheadLog:
         if not records:
             return []
         with self._lock:
+            self._raise_if_fenced()
             self._raise_if_committer_dead()
             out = [self._append_frame(r) for r in records]
             lsn = out[-1][1]
@@ -432,6 +483,80 @@ class WriteAheadLog:
         # appends whose write/fsync no one will ever serve
         if self.committer_error is not None:
             raise self.committer_error
+
+    # -- epoch fencing -----------------------------------------------------
+
+    @property
+    def epoch(self) -> int:
+        """Leader epoch stamped into every appended record."""
+        with self._lock:
+            return self._epoch
+
+    @property
+    def fenced(self) -> bool:
+        with self._lock:
+            return self._fenced_by is not None
+
+    def adopt_epoch(self, epoch: int) -> None:
+        """Raise this log's epoch to ``epoch`` (never lowers it) — the
+        recovery path: a restarted leader must come back writing in the
+        highest epoch its log already contains, or its fresh records
+        would read as a zombie's."""
+        with self._lock:
+            if epoch > self._epoch and (self._fenced_by is None
+                                        or epoch >= self._fenced_by):
+                self._epoch = int(epoch)
+                if self._fenced_by is not None \
+                        and self._epoch >= self._fenced_by:
+                    self._fenced_by = None  # caught up: fence satisfied
+                self._persist_fence_locked()
+
+    def fence(self, new_epoch: int) -> bool:
+        """Fence this log out of epochs below ``new_epoch``: a promotion
+        minted a newer leader generation, so every subsequent append on
+        this (now zombie) writer raises :class:`FencedWrite` instead of
+        growing the replicated history. Idempotent; returns True when
+        the fence engaged (False: ``new_epoch`` is not newer)."""
+        with self._lock:
+            if new_epoch <= self._epoch:
+                return False
+            if self._fenced_by is None or new_epoch > self._fenced_by:
+                self._fenced_by = int(new_epoch)
+                self._persist_fence_locked()
+            return True
+
+    def _raise_if_fenced(self) -> None:
+        # caller holds self._lock; sits beside _raise_if_committer_dead
+        # at the top of every append-side entry point
+        if self._fenced_by is None:
+            return
+        self.fence_rejected_appends += 1
+        self._persist_fence_locked()
+        if _trace.ENABLED:
+            now = time.perf_counter()
+            _trace.evt("fence_reject", now, 0.0, track="wal",
+                       args={"kind": "append", "epoch": self._epoch,
+                             "fenced_by": self._fenced_by})
+        raise FencedWrite(
+            f"WAL epoch {self._epoch} fenced by epoch "
+            f"{self._fenced_by}: this writer is a zombie ex-leader; "
+            f"its appends are rejected, never merged")
+
+    def _persist_fence_locked(self) -> None:
+        # best-effort sidecar for offline tooling; never fails a write
+        # path over telemetry
+        try:
+            import json
+            tmp = os.path.join(self.wal_dir, _FENCE_STATE_FILE + ".tmp")
+            with open(tmp, "w") as f:
+                json.dump({"schema": FENCE_STATE_SCHEMA,
+                           "epoch": self._epoch,
+                           "fenced_by": self._fenced_by,
+                           "rejected_appends": self.fence_rejected_appends},
+                          f)
+            os.replace(tmp, os.path.join(self.wal_dir, _FENCE_STATE_FILE))
+        except OSError:
+            pass
 
     def _request_durable(self, lsn: int) -> None:
         # caller holds self._lock: hand the barrier to the committer,
@@ -731,6 +856,7 @@ class WriteAheadLog:
         if self.fsync_policy != "tick":
             return
         with self._lock:
+            self._raise_if_fenced()
             self._raise_if_committer_dead()
             if self._synced_lsn >= self._written_lsn:
                 return

@@ -55,6 +55,10 @@ class RecoveryReport:
     skipped_ticks: int
     torn_tail: Optional[TornTail]
     final_tick: int
+    #: highest epoch stamped on any scanned record (0 = pre-fencing
+    #: log); the recovering WAL adopts it so a restarted leader can
+    #: never write records older than what its own log already holds
+    epoch: int = 0
 
     def as_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -151,6 +155,12 @@ def recover(sched, wal_dir: str, ckpt_dir: Optional[str] = None,
     finally:
         if suspended is not None:
             sched._wal_suspended = False
+    max_epoch = max((rec.get("epoch", 0) or 0 for _p, rec in records),
+                    default=0)
+    wal = getattr(sched, "wal", None)
+    if wal is not None and hasattr(wal, "adopt_epoch"):
+        wal.adopt_epoch(max_epoch)
+        max_epoch = wal.epoch
     return RecoveryReport(
         checkpoint_loaded=ckpt_loaded,
         checkpoint_tick=ckpt_tick,
@@ -161,4 +171,5 @@ def recover(sched, wal_dir: str, ckpt_dir: Optional[str] = None,
         skipped_ticks=ticks_skipped,
         torn_tail=torn,
         final_tick=sched._tick,
+        epoch=max_epoch,
     )

@@ -72,7 +72,10 @@ class Shipment(NamedTuple):
     that this shipment reaches the end of a sealed segment, in which
     case ``next_segment`` is where the log continues. ``leader_tick``
     piggybacks the leader's tick counter so receivers can publish a lag
-    gauge without a second channel."""
+    gauge without a second channel. ``epoch`` is the shipping leader's
+    epoch token (``wal/log.py`` fencing): a receiver rejects shipments
+    from an epoch below its own — a fenced zombie's bytes are never
+    merged. Defaulted so pre-epoch constructors stay valid."""
 
     segment: int
     offset: int
@@ -81,6 +84,7 @@ class Shipment(NamedTuple):
     seals: bool
     next_segment: Optional[int]
     leader_tick: int
+    epoch: int = 0
 
 
 class ShipAck(NamedTuple):
@@ -139,7 +143,8 @@ def iter_frames(payload: bytes, segment: int, base: int,
 
 class _FollowerState:
     __slots__ = ("name", "follower", "cursor", "applied_horizon",
-                 "bytes_total", "shipments", "nacks", "bootstraps")
+                 "bytes_total", "shipments", "nacks", "bootstraps",
+                 "fenced")
 
     def __init__(self, name: str, follower) -> None:
         self.name = name
@@ -150,6 +155,10 @@ class _FollowerState:
         self.shipments = 0
         self.nacks = 0
         self.bootstraps = 0
+        #: the follower rejected our epoch as stale: this shipper is a
+        #: zombie ex-leader's — stop re-offering, the bytes will never
+        #: be accepted (retrying would NACK-spin forever)
+        self.fenced = False
 
 
 class SegmentShipper:
@@ -171,10 +180,15 @@ class SegmentShipper:
                  ckpt_dir: Optional[str] = None,
                  leader_tick: Optional[Callable[[], int]] = None,
                  poll_s: float = 0.002,
-                 max_chunk_bytes: int = 1 << 20) -> None:
+                 max_chunk_bytes: int = 1 << 20,
+                 epoch: Optional[int] = None) -> None:
         if wal is None and wal_dir is None:
             raise ValueError("SegmentShipper needs a wal or a wal_dir")
         self.wal = wal
+        #: explicit epoch override (cold-log mode); with a live wal the
+        #: shipper reads ``wal.epoch`` at stamp time so a recovery-time
+        #: ``adopt_epoch`` is picked up without re-wiring
+        self._epoch = epoch
         self.wal_dir = wal_dir if wal_dir is not None else wal.wal_dir
         self.ckpt_dir = ckpt_dir
         self._leader_tick = leader_tick or (lambda: 0)
@@ -188,7 +202,16 @@ class SegmentShipper:
         self.shipments = 0
         self.nacks = 0
         self.crc_stops = 0
+        #: NACKs that named a newer epoch — this shipper is fenced
+        self.fence_nacks = 0
         self._metric_names: List[str] = []
+
+    @property
+    def epoch(self) -> int:
+        """The epoch stamped into every outgoing shipment."""
+        if self._epoch is not None:
+            return self._epoch
+        return self.wal.epoch if self.wal is not None else 0
 
     # -- membership --------------------------------------------------------
 
@@ -252,7 +275,8 @@ class SegmentShipper:
                        horizon: LogPosition) -> int:
         base = st.bytes_total
         guard = 0
-        while st.cursor is not None and st.cursor < horizon:
+        while (not st.fenced and st.cursor is not None
+               and st.cursor < horizon):
             guard += 1
             if guard > 10_000:  # paranoia: never wedge the pump loop
                 break
@@ -312,7 +336,7 @@ class SegmentShipper:
         seals = sealed and chunk_end == end
         nxt = self._next_segment(segs, cur.segment) if seals else None
         shipment = Shipment(cur.segment, cur.offset, payload, chunk_end,
-                            seals, nxt, self._leader_tick())
+                            seals, nxt, self._leader_tick(), self.epoch)
         t0 = time.perf_counter()
         resp = st.follower.receive(shipment)
         if _trace.ENABLED:
@@ -336,6 +360,13 @@ class SegmentShipper:
         # next pass re-read from disk (the WAL is the retransmit buffer)
         st.nacks += 1
         self.nacks += 1
+        if resp.reason.startswith("fenced"):
+            # the receiver is on a newer epoch: we are the zombie. Do
+            # NOT adopt its cursor — our log diverged at the promotion
+            # horizon; just stop offering this follower anything.
+            st.fenced = True
+            self.fence_nacks += 1
+            return False
         if resp.cursor is not None:
             st.cursor = LogPosition(*resp.cursor)
         return False

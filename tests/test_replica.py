@@ -381,8 +381,15 @@ def test_read_tier_routing_and_leader_fallback(tmp_path):
     assert tier_noleader.stale_reads == 1
 
     assert tier.max_lag_ticks() >= 0
-    with pytest.raises(NotImplementedError):
-        tier.promote(r1)  # failover actuator is still a stub
+    # promote() is real now (PR 11): r1 leaves the read rotation and
+    # becomes the leader fallback in a new epoch (the full failover
+    # sequence is covered in test_failover.py)
+    new_sched = tier.promote(r1, committer="inline")
+    assert r1.promoted and new_sched.wal.epoch == 1
+    assert all(x is not r1 for x in tier.replicas)
+    res = tier.view_at(sink.name, min_horizon=4)
+    assert res.source == "leader" and res.horizon == 4
+    new_sched.close()
     sched.close()
 
 

@@ -35,6 +35,14 @@ tracks; the report folds them into a **replication** section — per
 follower byte flow and NACKs, per replica applied records, replay time,
 and the published-horizon lag after each window.
 
+A trace recorded across a **leader failover** (``serve/failover.py``)
+carries ``failover_elect`` / ``failover_replay`` spans on the
+``failover`` and replica tracks and ``fence_reject`` spans wherever a
+zombie write was turned away; the report folds them into a **failover**
+section — promotions, elect/replay time, and fence rejects by kind
+(append vs shipment) — the promotion timeline an operator reads after
+pulling a leader.
+
 A trace recorded under a live ``ControlPlane`` also carries its
 actuations as zero-duration ``control.<action>`` spans on the
 ``control`` track; the report surfaces them as **control actions** —
@@ -95,6 +103,12 @@ def inspect(path: str) -> dict:
     replay_by_replica: dict = defaultdict(
         lambda: {"shipments": 0, "records_applied": 0, "replay_ms": 0.0,
                  "horizon": 0, "lag_ticks": 0, "max_lag_ticks": 0})
+    # failover (serve/failover.py, serve/replica.py, wal/log.py):
+    # failover_elect marks the decision, failover_replay the winner's
+    # mirrored-prefix replay, fence_reject every zombie write the new
+    # epoch turned away — the promotion timeline, span by span
+    failover_events: list = []
+    fence_rejects: dict = defaultdict(int)
     for ev in events:
         if ev.get("ph") == "X":
             by_name[ev.get("name", "?")].append(float(ev.get("dur", 0.0)))
@@ -133,6 +147,24 @@ def inspect(path: str) -> dict:
                 lag = int(a.get("lag_ticks", 0) or 0)
                 st["lag_ticks"] = lag
                 st["max_lag_ticks"] = max(st["max_lag_ticks"], lag)
+            if ev.get("name") == "failover_elect":
+                a = ev.get("args") or {}
+                failover_events.append({
+                    "event": "elect", "winner": a.get("winner"),
+                    "epoch": a.get("epoch"), "reason": a.get("reason"),
+                    "drained_bytes": a.get("drained_bytes"),
+                    "ms": round(float(ev.get("dur", 0.0)) / 1e3, 3)})
+            if ev.get("name") == "failover_replay":
+                a = ev.get("args") or {}
+                failover_events.append({
+                    "event": "replay", "epoch": a.get("epoch"),
+                    "horizon": a.get("horizon"),
+                    "replayed_pushes": a.get("replayed_pushes"),
+                    "replayed_ticks": a.get("replayed_ticks"),
+                    "ms": round(float(ev.get("dur", 0.0)) / 1e3, 3)})
+            if ev.get("name") == "fence_reject":
+                kind = (ev.get("args") or {}).get("kind") or "?"
+                fence_rejects[kind] += 1
             if ev.get("name") == "wal_fsync":
                 dur = float(ev.get("dur", 0.0))
                 if tid_names.get(ev.get("tid")) == "wal-committer":
@@ -220,12 +252,25 @@ def inspect(path: str) -> dict:
                 (v["lag_ticks"] for v in replay_by_replica.values()),
                 default=0),
         }
+    failover = None
+    if failover_events or fence_rejects:
+        failover = {
+            "promotions": sum(1 for e in failover_events
+                              if e["event"] == "elect"),
+            "elect_ms": round(sum(e["ms"] for e in failover_events
+                                  if e["event"] == "elect"), 3),
+            "replay_ms": round(sum(e["ms"] for e in failover_events
+                                   if e["event"] == "replay"), 3),
+            "fence_rejects": dict(sorted(fence_rejects.items())),
+            "events": failover_events,
+        }
     return {
         "schema": "reflow.trace_inspect/1",
         "trace_file": path,
         "events": sum(len(d) for d in by_name.values()),
         "tracks": len(tracks),
         "durability": durability,
+        "failover": failover,
         "window_dispatch_frac": window_dispatch_frac,
         "stage_overlap_frac": stage_overlap_frac,
         "dispatch_by_depth": dispatch_by_depth,
@@ -286,6 +331,23 @@ def _print_human(s: dict) -> None:
             print(f"  ship->{name}: {d['shipments']} shipment(s) "
                   f"{d['bytes']} byte(s) in {d['ship_ms']:.2f}ms, "
                   f"{d['nacks']} nack(s)")
+    fo = s.get("failover")
+    if fo:
+        rej = ", ".join(f"{v} {k}(s)"
+                        for k, v in fo["fence_rejects"].items()) or "none"
+        print(f"failover: {fo['promotions']} promotion(s) — elect "
+              f"{fo['elect_ms']:.2f}ms, replay {fo['replay_ms']:.2f}ms; "
+              f"fence rejects: {rej}")
+        for e in fo["events"]:
+            if e["event"] == "elect":
+                print(f"  epoch {e['epoch']}: elected {e['winner']} "
+                      f"({e['reason']}), drained "
+                      f"{e['drained_bytes']} byte(s) in {e['ms']:.2f}ms")
+            else:
+                print(f"  epoch {e['epoch']}: replayed "
+                      f"{e['replayed_pushes']} push(es) / "
+                      f"{e['replayed_ticks']} tick(s) to horizon "
+                      f"{e['horizon']} in {e['ms']:.2f}ms")
     if s["control_actions"]:
         acts = ", ".join(f"{k}={v}"
                          for k, v in s["control_actions"].items())

@@ -69,14 +69,19 @@ def inspect(wal_dir: str, *, verbose: bool = True) -> dict:
     run = 0
     per_seg: dict = {
         seg: {"segment": seg, "bytes": os.path.getsize(path),
-              "records": 0, "pushes": 0, "rows": 0, "micro_batches": 0}
+              "records": 0, "pushes": 0, "rows": 0, "micro_batches": 0,
+              "epoch": 0}
         for seg, path in segs}
+    max_epoch = 0
     for pos, rec in records:
         kind = rec.get("kind", "?")
         counts[kind] = counts.get(kind, 0) + 1
+        ep = int(rec.get("epoch", 0) or 0)
+        max_epoch = max(max_epoch, ep)
         seg = per_seg.get(pos.segment)
         if seg is not None:
             seg["records"] += 1
+            seg["epoch"] = max(seg["epoch"], ep)
         if kind == "push":
             n = len(np.asarray(rec["weights"]))
             rows += n
@@ -124,8 +129,33 @@ def inspect(wal_dir: str, *, verbose: bool = True) -> dict:
             float(np.percentile(win, 95)) if len(win) else 0.0),
         "segments_detail": [per_seg[s] for s in sorted(per_seg)],
         "shipping": shipping,
+        "epochs": _epoch_summary(wal_dir, max_epoch),
         "torn_tail": torn._asdict() if torn is not None else None,
     }
+
+
+def _epoch_summary(wal_dir: str, record_max: int):
+    """Failover lineage: the highest epoch stamped into any record,
+    merged with the ``fence-state.json`` sidecar a fenced (zombie)
+    writer leaves behind. ``fenced`` means a NEWER epoch exists — this
+    log's writer must never append again."""
+    out = {"record_max": record_max, "epoch": record_max,
+           "fenced_by": None, "fenced": False, "rejected_appends": 0}
+    path = os.path.join(wal_dir, "fence-state.json")
+    try:
+        with open(path) as f:
+            state = json.load(f)
+    except OSError:
+        return out
+    except ValueError as e:
+        out["error"] = f"unreadable fence-state.json: {e}"
+        return out
+    out["epoch"] = max(record_max, int(state.get("epoch") or 0))
+    fb = state.get("fenced_by")
+    out["fenced_by"] = int(fb) if fb is not None else None
+    out["fenced"] = fb is not None and int(fb) > out["epoch"]
+    out["rejected_appends"] = int(state.get("rejected_appends") or 0)
+    return out
 
 
 def _ship_summary(wal_dir: str, per_seg: dict):
@@ -219,6 +249,13 @@ def main(argv=None) -> int:
                       f"applied_horizon={f['applied_horizon']} "
                       f"lag_ticks={f['lag_ticks']} "
                       f"bytes={f['bytes_total']} nacks={f['nacks']}")
+        ep = summary["epochs"]
+        if ep["epoch"] or ep["fenced_by"] is not None:
+            status = (f" FENCED by epoch {ep['fenced_by']} — zombie "
+                      f"writer, {ep['rejected_appends']} append(s) "
+                      f"rejected" if ep["fenced"] else "")
+            print(f"epochs: current={ep['epoch']} "
+                  f"record_max={ep['record_max']}{status}")
         if torn:
             print(f"torn tail (tolerated): segment {torn['segment']} @ "
                   f"{torn['offset']}: {torn['reason']}")
