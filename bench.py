@@ -159,6 +159,8 @@ import time
 
 import numpy as np
 
+from reflow_tpu.utils.config import (env_flag, env_float, env_int, env_str)
+
 
 def log(*a) -> None:
     print(*a, file=sys.stderr, flush=True)
@@ -187,19 +189,15 @@ def _synced_tick(sched):
 
 
 def _params():
-    smoke = os.environ.get("REFLOW_BENCH_SMOKE") == "1"
+    smoke = env_flag("REFLOW_BENCH_SMOKE")
     return {
         "smoke": smoke,
-        "n_nodes": int(os.environ.get(
-            "REFLOW_BENCH_NODES", 1_000 if smoke else 100_000)),
-        "n_edges": int(os.environ.get(
-            "REFLOW_BENCH_EDGES", 10_000 if smoke else 1_000_000)),
-        "churn": float(os.environ.get("REFLOW_BENCH_CHURN", 0.01)),
-        "stream_ticks": int(os.environ.get(
-            "REFLOW_BENCH_STREAM_TICKS", 4 if smoke else 16)),
-        "cpu_cap": int(os.environ.get(
-            "REFLOW_BENCH_CPU_EDGES_CAP", 10_000 if smoke else 200_000)),
-        "cpu_full": os.environ.get("REFLOW_BENCH_CPU_FULL") == "1",
+        "n_nodes": env_int("REFLOW_BENCH_NODES", 1_000 if smoke else 100_000),
+        "n_edges": env_int("REFLOW_BENCH_EDGES", 10_000 if smoke else 1_000_000),
+        "churn": env_float("REFLOW_BENCH_CHURN", 0.01),
+        "stream_ticks": env_int("REFLOW_BENCH_STREAM_TICKS", 4 if smoke else 16),
+        "cpu_cap": env_int("REFLOW_BENCH_CPU_EDGES_CAP", 10_000 if smoke else 200_000),
+        "cpu_full": env_flag("REFLOW_BENCH_CPU_FULL"),
         "tol": 1e-4,
         # cross-tick residual deferral (close_loop defer_passes) for the
         # pr_tpu_defer child — the incr_vs_full lever (VERDICT r4 #1);
@@ -214,7 +212,7 @@ def _defer_env():
     # defer=1 dominates defer=2 on this workload: same worst-key
     # mid-stream rel lag (0.352 vs 0.367 measured) and the same drained
     # band (rel ~1.4e-4), at 74.5 vs 92 ms per tick
-    raw = os.environ.get("REFLOW_BENCH_DEFER", "1").strip()
+    raw = env_str("REFLOW_BENCH_DEFER", "1").strip()
     try:
         v = int(raw)
     except ValueError:
@@ -248,7 +246,7 @@ def run_recovery_bench() -> dict:
     from reflow_tpu.wal import DurableScheduler, recover
     from reflow_tpu.workloads import wordcount
 
-    backlog = int(os.environ.get("REFLOW_BENCH_RECOVERY_TICKS", "1000"))
+    backlog = env_int("REFLOW_BENCH_RECOVERY_TICKS", "1000")
     rows_per_tick = 8
 
     def drive(sched, src):
@@ -326,8 +324,8 @@ def run_recovery_bench() -> dict:
     from reflow_tpu.delta import DeltaBatch, Spec
     from reflow_tpu.executors import get_executor
 
-    tpu_backlog = int(os.environ.get(
-        "REFLOW_BENCH_RECOVERY_TPU_TICKS", str(max(8, backlog // 10))))
+    tpu_backlog = env_int(
+        "REFLOW_BENCH_RECOVERY_TPU_TICKS", max(8, backlog // 10))
 
     def build_dev():
         g = FlowGraph("recovery_dev")
@@ -658,9 +656,8 @@ def run_serve_bench() -> dict:
     from reflow_tpu.utils.metrics import summarize, summarize_serve
     from reflow_tpu.workloads import wordcount
 
-    smoke = os.environ.get("REFLOW_BENCH_SMOKE") == "1"
-    per_producer = int(os.environ.get(
-        "REFLOW_BENCH_SERVE_BATCHES", "40" if smoke else "250"))
+    smoke = env_flag("REFLOW_BENCH_SMOKE")
+    per_producer = env_int("REFLOW_BENCH_SERVE_BATCHES", "40" if smoke else "250")
     rows_per_batch = 8
 
     def make_lines(producer: int, j: int) -> list:
@@ -763,9 +760,8 @@ def run_obs_bench() -> dict:
     from reflow_tpu.wal import DurableScheduler
     from reflow_tpu.workloads import wordcount
 
-    smoke = os.environ.get("REFLOW_BENCH_SMOKE") == "1"
-    per_producer = int(os.environ.get(
-        "REFLOW_BENCH_OBS_BATCHES", "40" if smoke else "250"))
+    smoke = env_flag("REFLOW_BENCH_SMOKE")
+    per_producer = env_int("REFLOW_BENCH_OBS_BATCHES", "40" if smoke else "250")
     rows_per_batch = 8
     n_prod = 16
 
@@ -843,8 +839,7 @@ def run_obs_bench() -> dict:
 
         # export + decomposition check on the enabled run's rings
         events = obs.chrome_events()
-        trace_path = os.environ.get("REFLOW_TRACE_OUT",
-                                    "/tmp/reflow_obs_trace.json")
+        trace_path = env_str("REFLOW_TRACE_OUT", "/tmp/reflow_obs_trace.json")
         obs.export_chrome_trace(trace_path)
         out["trace_file"] = trace_path
         out["trace_events"] = sum(1 for e in events if e.get("ph") == "X")
@@ -913,7 +908,7 @@ def run_walpipe_bench() -> dict:
     from reflow_tpu.serve import CoalesceWindow, IngestFrontend
     from reflow_tpu.wal import DurableScheduler, recover
 
-    smoke = os.environ.get("REFLOW_BENCH_SMOKE") == "1"
+    smoke = env_flag("REFLOW_BENCH_SMOKE")
     key_space, feat = 64, 64
     rows = 8192  # one batch == one window == one ~2 MB group commit
 
@@ -1004,8 +999,7 @@ def run_walpipe_bench() -> dict:
     # is the acceptance number, so it gets best-of-N paired trials to
     # shave ext4 writeback noise; smoke keeps the same window shape
     # (the speedup comes from the shape) but trims the run
-    per16 = int(os.environ.get(
-        "REFLOW_BENCH_WALPIPE_BATCHES", "2" if smoke else "4"))
+    per16 = env_int("REFLOW_BENCH_WALPIPE_BATCHES", "2" if smoke else "4")
     legs = [(16, per16, 1 if smoke else 2)]
     if not smoke:
         legs.insert(0, (4, 8, 1))
@@ -1124,14 +1118,13 @@ def run_replica_bench() -> dict:
     from reflow_tpu.wal import DurableScheduler, SegmentShipper
     from reflow_tpu.workloads import wordcount
 
-    smoke = os.environ.get("REFLOW_BENCH_SMOKE") == "1"
-    n_replicas = int(os.environ.get("REFLOW_BENCH_REPLICA_N", "4"))
+    smoke = env_flag("REFLOW_BENCH_SMOKE")
+    n_replicas = env_int("REFLOW_BENCH_REPLICA_N", "4")
     n_producers = 16
     n_readers = 4
     window_ticks = 4
     vocab = 2_000 if smoke else 20_000
-    read_s = float(os.environ.get(
-        "REFLOW_BENCH_REPLICA_READ_S", "0.6" if smoke else "2.0"))
+    read_s = env_float("REFLOW_BENCH_REPLICA_READ_S", "0.6" if smoke else "2.0")
     topk = 10
 
     tmp = tempfile.mkdtemp(prefix="reflow-replica-")
@@ -1346,13 +1339,12 @@ def run_failover_bench() -> dict:
     from reflow_tpu.wal import DurableScheduler, FencedWrite, SegmentShipper
     from reflow_tpu.workloads import wordcount
 
-    smoke = os.environ.get("REFLOW_BENCH_SMOKE") == "1"
-    n_replicas = int(os.environ.get("REFLOW_BENCH_FAILOVER_N", "2"))
+    smoke = env_flag("REFLOW_BENCH_SMOKE")
+    n_replicas = env_int("REFLOW_BENCH_FAILOVER_N", "2")
     n_producers = 16
     window_ticks = 4
     vocab = 2_000 if smoke else 20_000
-    run_s = float(os.environ.get(
-        "REFLOW_BENCH_FAILOVER_RUN_S", "0.3" if smoke else "1.0"))
+    run_s = env_float("REFLOW_BENCH_FAILOVER_RUN_S", "0.3" if smoke else "1.0")
 
     tmp = tempfile.mkdtemp(prefix="reflow-failover-")
     out = {"replicas": n_replicas, "producers": n_producers,
@@ -1587,9 +1579,8 @@ def run_tier_bench() -> dict:
     from reflow_tpu.wal import DurableScheduler, recover
     from reflow_tpu.workloads import wordcount
 
-    smoke = os.environ.get("REFLOW_BENCH_SMOKE") == "1"
-    per_producer = int(os.environ.get(
-        "REFLOW_BENCH_TIER_BATCHES", "30" if smoke else "200"))
+    smoke = env_flag("REFLOW_BENCH_SMOKE")
+    per_producer = env_int("REFLOW_BENCH_TIER_BATCHES", "30" if smoke else "200")
     rows_per_batch = 8
     n_graphs = n_prod = 4
     window = CoalesceWindow(max_rows=4096, max_ticks=8,
@@ -1850,12 +1841,11 @@ def run_shardserve_bench() -> dict:
     from reflow_tpu.scheduler import DirtyScheduler
     from reflow_tpu.serve import CoalesceWindow, GraphConfig, ServeTier
 
-    smoke = os.environ.get("REFLOW_BENCH_SMOKE") == "1"
+    smoke = env_flag("REFLOW_BENCH_SMOKE")
     n_graphs = 8
     key_space = 256
     rows_per_batch = 64
-    per_producer = int(os.environ.get(
-        "REFLOW_BENCH_SHARDSERVE_BATCHES", "8" if smoke else "48"))
+    per_producer = env_int("REFLOW_BENCH_SHARDSERVE_BATCHES", "8" if smoke else "48")
     window = CoalesceWindow(max_rows=4096, max_ticks=4,
                             max_latency_s=0.003)
     n_devices = len(jax.devices())
@@ -2056,7 +2046,7 @@ def run_control_bench() -> dict:
     from reflow_tpu.utils.faults import StormInjector
     from reflow_tpu.workloads import wordcount
 
-    smoke = os.environ.get("REFLOW_BENCH_SMOKE") == "1"
+    smoke = env_flag("REFLOW_BENCH_SMOKE")
     kn = control_scenario(smoke)
     rows_per_batch = 8
     window = CoalesceWindow(max_rows=4096, max_ticks=8,
@@ -2394,7 +2384,7 @@ def run_pagerank_tpu_child(defer=None) -> dict:
     sched.push(pr.edges, web.churn(p["churn"]))
     synced_s, _ = _timed_tick(sched)
 
-    trace_dir = os.environ.get("REFLOW_BENCH_TRACE")
+    trace_dir = env_str("REFLOW_BENCH_TRACE", None)
     if trace_dir:
         from reflow_tpu.utils.metrics import profile_trace
         sched.push(pr.edges, web.churn(p["churn"]))
@@ -2542,7 +2532,7 @@ def main() -> None:
     cli, _ = ap.parse_known_args()
     json_out = cli.json_out
 
-    if os.environ.get("REFLOW_BENCH_TIER") == "1":
+    if env_flag("REFLOW_BENCH_TIER"):
         # tier mode is host-side CPU work — no tunnel, no subprocesses
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         out = run_tier_bench()
@@ -2554,7 +2544,7 @@ def main() -> None:
         }, json_out)
         return
 
-    if os.environ.get("REFLOW_BENCH_SHARDSERVE") == "1":
+    if env_flag("REFLOW_BENCH_SHARDSERVE"):
         # pod-scale serving mode: on cpu, force 8 host devices BEFORE jax
         # imports so the spread/sharded tiers have a mesh to span (a real
         # TPU platform uses its native device set)
@@ -2574,7 +2564,7 @@ def main() -> None:
         }, json_out)
         return
 
-    if os.environ.get("REFLOW_BENCH_CONTROL") == "1":
+    if env_flag("REFLOW_BENCH_CONTROL"):
         # control mode is host-side CPU work — no tunnel, no subprocesses
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         out = run_control_bench()
@@ -2586,7 +2576,7 @@ def main() -> None:
         }, json_out)
         return
 
-    if os.environ.get("REFLOW_BENCH_SERVE") == "1":
+    if env_flag("REFLOW_BENCH_SERVE"):
         # serve mode is host-side CPU work — no tunnel, no subprocesses
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         out = run_serve_bench()
@@ -2598,7 +2588,7 @@ def main() -> None:
         }, json_out)
         return
 
-    if os.environ.get("REFLOW_BENCH_WALPIPE") == "1":
+    if env_flag("REFLOW_BENCH_WALPIPE"):
         # walpipe mode is host-side CPU work — no tunnel, no subprocesses
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         out = run_walpipe_bench()
@@ -2610,7 +2600,7 @@ def main() -> None:
         }, json_out)
         return
 
-    if os.environ.get("REFLOW_BENCH_REPLICA") == "1":
+    if env_flag("REFLOW_BENCH_REPLICA"):
         # replica mode is host-side CPU work — no tunnel, no subprocesses
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         out = run_replica_bench()
@@ -2622,7 +2612,7 @@ def main() -> None:
         }, json_out)
         return
 
-    if os.environ.get("REFLOW_BENCH_FAILOVER") == "1":
+    if env_flag("REFLOW_BENCH_FAILOVER"):
         # failover mode is host-side CPU work — no tunnel, no subprocesses
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         out = run_failover_bench()
@@ -2634,7 +2624,7 @@ def main() -> None:
         }, json_out)
         return
 
-    if os.environ.get("REFLOW_BENCH_OBS") == "1":
+    if env_flag("REFLOW_BENCH_OBS"):
         # obs mode is host-side CPU work — no tunnel, no subprocesses
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         out = run_obs_bench()
@@ -2646,7 +2636,7 @@ def main() -> None:
         }, json_out)
         return
 
-    if os.environ.get("REFLOW_BENCH_RECOVERY") == "1":
+    if env_flag("REFLOW_BENCH_RECOVERY"):
         # WAL mode is mostly host-side work; the device-path section runs
         # on whatever backend JAX_PLATFORMS selects (default cpu)
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -2659,7 +2649,7 @@ def main() -> None:
         }, json_out)
         return
 
-    if os.environ.get("REFLOW_BENCH_PIPELINE") == "1":
+    if env_flag("REFLOW_BENCH_PIPELINE"):
         # pipelined-window mode measures the device window path — do NOT
         # force cpu; the tier-1 smoke sets JAX_PLATFORMS=cpu explicitly
         out = run_pipeline_bench()
@@ -2671,7 +2661,7 @@ def main() -> None:
         }, json_out)
         return
 
-    if os.environ.get("REFLOW_BENCH_MEGATICK") == "1":
+    if env_flag("REFLOW_BENCH_MEGATICK"):
         # mega-tick mode measures the device window path — do NOT force
         # cpu here; the tier-1 smoke sets JAX_PLATFORMS=cpu explicitly
         out = run_megatick_bench()
@@ -2683,7 +2673,7 @@ def main() -> None:
         }, json_out)
         return
 
-    child = os.environ.get("REFLOW_BENCH_CHILD")
+    child = env_str("REFLOW_BENCH_CHILD", None)
     if child:
         try:
             out = _CHILDREN[child]()
@@ -2700,7 +2690,7 @@ def main() -> None:
 
     # configs 1/2/4/5 first (records on stderr), headline (config 3) last
     # so the final stdout line stays the parseable result
-    if os.environ.get("REFLOW_BENCH_ALL", "1") == "1":
+    if env_flag("REFLOW_BENCH_ALL"):
         for name in ("cfg1", "cfg2", "cfg4", "cfg5"):
             r = _spawn(name)
             if "error" in r:

@@ -1,0 +1,24 @@
+"""reflow_tpu.analysis — project-specific static analysis (reflow-lint).
+
+The serving stack's correctness rests on conventions no general linter
+knows: lock acquisition order, crash-seam grammar and test coverage,
+metrics register/unregister pairing, the env-knob registry, and the
+no-bare-assert exception policy. This package machine-checks them.
+
+Entry point: ``python tools/reflow_lint.py`` (``--json`` emits the
+``reflow.lint/1`` schema). Library use::
+
+    from reflow_tpu.analysis import run
+    report = run("/path/to/repo")          # all fast passes
+    report["findings"]                      # list of dicts
+
+The runtime twin of the lock pass is ``REFLOW_LOCKCHECK=1`` +
+``named_lock`` in :mod:`reflow_tpu.utils.runtime` — see docs/guide.md
+"Static analysis & lockcheck".
+"""
+
+from reflow_tpu.analysis.core import (Corpus, Finding, PASSES, RULES,
+                                      render_report, run, to_json)
+
+__all__ = ["Corpus", "Finding", "PASSES", "RULES", "render_report",
+           "run", "to_json"]

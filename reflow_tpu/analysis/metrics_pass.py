@@ -1,0 +1,79 @@
+"""Metrics-hygiene pass: registration pairing and naming.
+
+- **metrics-unpaired** — a file that registers metric sources or gauges
+  (``register_source(...)`` / ``reg.gauge(...)``) must also contain an
+  unregister path (``unregister_source`` / ``unregister_prefix``).
+  Sources and gauges hold lambdas that capture ``self``; a close/seal
+  that does not unregister leaves the registry reading a dead object
+  forever (and pins it in memory). The check is per-file by design:
+  the unregister belongs next to the register (``publish_metrics`` /
+  ``close`` live on the same class), not in some caller.
+- **metrics-name** — metric name literals must be dotted lower_snake
+  (``wal.fsync_rate``, ``serve.<graph>.depth``): one grammar means
+  ``unregister_prefix(f"{key}.")`` and dashboards can rely on the
+  separator. F-string names are checked on their literal fragments.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from reflow_tpu.analysis.core import Corpus, Finding, register_pass
+
+_NAME_FRAG = re.compile(r"^[a-z0-9_.]*$")
+
+RULES = {
+    "metrics-unpaired": "register_source/gauge without an unregister "
+                        "path in the same file",
+    "metrics-name": "metric names must be dotted lower_snake",
+}
+
+_REGISTERING = ("register_source", "gauge", "counter")
+_UNREGISTERING = ("unregister_source", "unregister_prefix")
+
+
+def _name_fragments(arg: ast.expr) -> List[str]:
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return [arg.value]
+    if isinstance(arg, ast.JoinedStr):
+        return [str(p.value) for p in arg.values
+                if isinstance(p, ast.Constant)]
+    return []
+
+
+@register_pass("metrics", RULES)
+def metrics_pass(corpus: Corpus) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in corpus.under("reflow_tpu/"):
+        if sf.tree is None or sf.path.startswith((
+                "reflow_tpu/analysis/", "reflow_tpu/obs/registry.py")):
+            continue  # the registry defines the API; it can't pair it
+        registers: List[ast.Call] = []
+        unregisters = 0
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            attr = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if attr in _REGISTERING and node.args:
+                registers.append(node)
+                for frag in _name_fragments(node.args[0]):
+                    if not _NAME_FRAG.match(frag):
+                        findings.append(Finding(
+                            "metrics-name", sf.path, node.lineno,
+                            f"metric name fragment {frag!r} is not "
+                            f"dotted lower_snake"))
+            elif attr in _UNREGISTERING:
+                unregisters += 1
+        if registers and not unregisters:
+            n = registers[0]
+            findings.append(Finding(
+                "metrics-unpaired", sf.path, n.lineno,
+                f"{len(registers)} metric registration(s) but no "
+                f"unregister_source/unregister_prefix in this file — "
+                f"the close/seal path must drop them or the registry "
+                f"keeps reading a dead object"))
+    return findings

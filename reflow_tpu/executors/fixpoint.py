@@ -193,7 +193,8 @@ def make_scan_program(tick_fn):
         def body(states, ing):
             states2, sink_eg, _carry, iters, rows, conv = tick_fn(states,
                                                                   ing)
-            assert not sink_eg, "macro-tick requires a sink-free graph"
+            if sink_eg:  # trace-time structural check
+                raise RuntimeError("macro-tick requires a sink-free graph")
             return states2, (iters, rows, conv)
 
         states, ys = jax.lax.scan(body, op_states, ing_stack)

@@ -907,7 +907,9 @@ class LinearFixpointProgram(_MacroTickMixin):
                 def body(carry, ing):
                     st, c = carry
                     st2, c2, sink_eg, iters, rows, conv = tick_fn(st, c, ing)
-                    assert not sink_eg, "macro-tick requires a sink-free graph"
+                    if sink_eg:  # trace-time structural check
+                        raise RuntimeError(
+                            "macro-tick requires a sink-free graph")
                     return (st2, c2), (iters, rows, conv)
 
                 (states, csr), ys = jax.lax.scan(body, (op_states, csr),

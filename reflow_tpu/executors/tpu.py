@@ -36,6 +36,8 @@ from reflow_tpu.executors.lowerings import (DEVICE_REDUCERS, join_state,
                                             lower_node, reduce_state)
 from reflow_tpu.graph import FlowGraph, GraphError, Node
 from reflow_tpu.obs import trace as _trace
+from reflow_tpu.utils.config import env_int
+from reflow_tpu.utils.runtime import named_lock
 
 __all__ = ["TpuExecutor", "StagedWindow"]
 
@@ -54,7 +56,7 @@ __all__ = ["TpuExecutor", "StagedWindow"]
 # a closure, fn-less callables) falls back to the per-executor cache.
 
 _SHARED_WINDOW_PROGRAMS: Dict[tuple, object] = {}
-_SHARED_WINDOW_LOCK = threading.Lock()
+_SHARED_WINDOW_LOCK = named_lock("executors.window_cache")
 
 
 class _Unshareable(Exception):
@@ -174,8 +176,7 @@ class TpuExecutor(Executor):
         #: mega-tick window path (run_window): per-source host batches
         #: above this row bound don't fit a reasonable queue slot — the
         #: scheduler falls back to the per-tick path instead
-        self.megatick_max_rows = int(os.environ.get(
-            "REFLOW_MEGATICK_MAX_ROWS", str(1 << 16)))
+        self.megatick_max_rows = env_int("REFLOW_MEGATICK_MAX_ROWS")
         #: windows dispatched through the device-resident ingress queue
         self.window_dispatches = 0
         #: tenant placement: the jax.Device this executor's state, ingress
@@ -727,8 +728,9 @@ class TpuExecutor(Executor):
                     def scan_fn(op_states, ing_stack):
                         def body(states, ing):
                             states2, egress = pass_fn(states, ing)
-                            assert not egress, ("loop-free sink-free pass "
-                                                "produced egress")
+                            if egress:  # trace-time structural check
+                                raise RuntimeError("loop-free sink-free "
+                                                   "pass produced egress")
                             return states2, ()
 
                         states, _ = jax.lax.scan(body, op_states, ing_stack)

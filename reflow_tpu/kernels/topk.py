@@ -15,7 +15,6 @@ and fold it into a running (values, ids) top-k carry.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional, Tuple
 
 import jax
@@ -36,7 +35,8 @@ def _remote_tunnel_runtime() -> bool:
 
 
 def _pallas_default() -> Optional[bool]:
-    env = os.environ.get("REFLOW_TOPK_PALLAS")
+    from reflow_tpu.utils.config import env_str
+    env = env_str("REFLOW_TOPK_PALLAS", None)
     if env is not None:
         return env == "1"
     if _remote_tunnel_runtime():

@@ -18,7 +18,6 @@ never drift apart.
 from __future__ import annotations
 
 import json
-import os
 from typing import Any, Dict, List, Optional
 
 from . import trace
@@ -63,7 +62,8 @@ def chrome_events() -> List[Dict[str, Any]]:
 def export_chrome_trace(path: Optional[str] = None) -> str:
     """Write the chrome trace JSON; returns the path written
     (``REFLOW_TRACE_OUT`` or ``reflow_trace.json`` by default)."""
-    path = path or os.environ.get("REFLOW_TRACE_OUT", "reflow_trace.json")
+    from reflow_tpu.utils.config import env_str
+    path = path or env_str("REFLOW_TRACE_OUT")
     with open(path, "w") as f:
         json.dump({"traceEvents": chrome_events(),
                    "displayTimeUnit": "ms"}, f)

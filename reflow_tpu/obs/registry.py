@@ -30,6 +30,8 @@ from __future__ import annotations
 import json
 import threading
 import time
+
+from reflow_tpu.utils.runtime import named_lock
 from collections import deque
 from typing import Any, Callable, Dict, Optional
 
@@ -101,7 +103,7 @@ class MetricsRegistry:
     :meth:`snapshot` (always ``json.dumps``-clean)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.registry")
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._sources: Dict[str, Callable[[], Dict[str, Any]]] = {}

@@ -37,7 +37,6 @@ execute, the exposed disk latency when it didn't. The committer's own
 from __future__ import annotations
 
 import itertools
-import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
@@ -51,8 +50,10 @@ __all__ = ["ENABLED", "RING_CAPACITY", "SAMPLE_EVERY", "STAGES",
 #: instrumentation site; never wrapped in a function call
 ENABLED = False
 
-RING_CAPACITY = int(os.environ.get("REFLOW_TRACE_RING", "65536"))
-SAMPLE_EVERY = max(1, int(os.environ.get("REFLOW_TRACE_SAMPLE", "16")))
+from reflow_tpu.utils.config import env_flag, env_int
+
+RING_CAPACITY = env_int("REFLOW_TRACE_RING")
+SAMPLE_EVERY = max(1, env_int("REFLOW_TRACE_SAMPLE"))
 
 #: the per-ticket stage names, in pipeline order
 STAGES = ("admission", "coalesce", "sched_delay", "execute", "fsync",
@@ -62,7 +63,9 @@ STAGES = ("admission", "coalesce", "sched_delay", "execute", "fsync",
 Event = Tuple[str, float, float, Optional[str], Optional[Dict[str, Any]]]
 
 _rings: List["Ring"] = []
-_rings_lock = threading.Lock()  # ring *registration* only, never puts
+from reflow_tpu.utils.runtime import named_lock
+
+_rings_lock = named_lock("obs.trace.rings")  # ring *registration* only, never puts
 _tls = threading.local()
 _gen = 0
 _mint_n = itertools.count()
@@ -204,5 +207,5 @@ def wal_accum_take() -> float:
     return s
 
 
-if os.environ.get("REFLOW_TRACE") == "1":
+if env_flag("REFLOW_TRACE"):
     enable()

@@ -18,7 +18,6 @@ in the executor.
 from __future__ import annotations
 
 import dataclasses
-import os
 import time
 from collections import Counter, defaultdict
 from typing import Callable, Dict, List, Optional, Sequence
@@ -27,6 +26,7 @@ import numpy as np
 
 from reflow_tpu.delta import DeltaBatch
 from reflow_tpu.executors import CpuExecutor, Executor
+from reflow_tpu.utils.config import env_float
 from reflow_tpu.graph import FlowGraph, GraphError, Node
 from reflow_tpu.obs import trace as _trace
 
@@ -211,6 +211,7 @@ class DirtyScheduler:
         #: the newest ``dedup_window`` ids (upstream redelivery must stay
         #: within that horizon)
         self._seen_batch_ids: Dict[str, None] = {}
+        self._metric_keys: list = []  # (registry, key) published
         self.dedup_window = dedup_window
         self._tick = 0
         self.sink_views: Dict[str, Counter] = {s.name: Counter() for s in graph.sinks}
@@ -230,8 +231,7 @@ class DirtyScheduler:
         #: max tolerated padding waste: the fraction of the window's
         #: (tick, source) slots that would be zero-row padding. Divergent
         #: per-tick dirty sets above this run the per-tick path instead
-        self.megatick_waste = float(os.environ.get(
-            "REFLOW_MEGATICK_WASTE", "0.5"))
+        self.megatick_waste = env_float("REFLOW_MEGATICK_WASTE")
 
     # -- host boundary in --------------------------------------------------
 
@@ -733,6 +733,7 @@ class DirtyScheduler:
                   lambda: self.megatick_fallbacks)
         reg.gauge(f"{key}.megatick_cache_hits",
                   lambda: getattr(self.executor, "megatick_cache_hits", 0))
+        self._metric_keys.append((reg, key))
         return key
 
     def rederive(self, source: Node, batch: DeltaBatch):
@@ -809,11 +810,14 @@ class DirtyScheduler:
             f"is genuinely divergent)")
 
     def close(self) -> None:
-        """Release durable resources. A no-op here — the in-memory
-        scheduler holds none — but part of the scheduler surface so
-        lifecycle code (``IngestFrontend.close``, ``ServeTier``) can
-        shut any scheduler down uniformly; ``DurableScheduler``
-        overrides it to seal its WAL."""
+        """Release durable resources: just the published obs gauges
+        here (the in-memory scheduler holds nothing else) — part of the
+        scheduler surface so lifecycle code (``IngestFrontend.close``,
+        ``ServeTier``) can shut any scheduler down uniformly;
+        ``DurableScheduler`` overrides it to also seal its WAL."""
+        for reg, key in self._metric_keys:
+            reg.unregister_prefix(f"{key}.")
+        self._metric_keys = []
 
     # -- host boundary out -------------------------------------------------
 

@@ -122,6 +122,7 @@ class AdmissionBudget:
         self.used = 0
         self.peak = 0
         self._shares: Dict[str, BudgetShare] = {}
+        self._metric_keys: list = []  # (registry, prefix) published
 
     # -- registration ------------------------------------------------------
 
@@ -239,7 +240,16 @@ class AdmissionBudget:
         reg.gauge(f"{name}.peak_bytes", lambda: self.peak)
         reg.gauge(f"{name}.occupancy",
                   lambda: self.used / self.total_bytes)
+        self._metric_keys.append((reg, name))
         reg.gauge(f"{name}.per_share_used",
                   lambda: {s.name: s.used
                            for s in self._shares.values()})
         return name
+
+    def unpublish_metrics(self) -> None:
+        """Drop every gauge :meth:`publish_metrics` registered — the
+        tier calls this at close so a re-created budget never leaves
+        stale lambdas capturing a dead instance in the registry."""
+        for reg, prefix in self._metric_keys:
+            reg.unregister_prefix(f"{prefix}.")
+        self._metric_keys = []

@@ -72,6 +72,8 @@ from typing import Deque, Dict, List, Optional, Tuple
 from reflow_tpu.graph import GraphError, Node
 from reflow_tpu.obs import trace as _trace
 from reflow_tpu.scheduler import SourceCursor
+from reflow_tpu.utils.config import env_int
+from reflow_tpu.utils.runtime import named_lock
 
 from .budget import AdmissionBudget
 from .coalesce import CoalesceWindow, build_feeds
@@ -170,7 +172,7 @@ class IngestFrontend:
         #: behavior; >1 requires the staged scheduler surface, so it is
         #: forced to 1 off the fused mega-tick path.
         if depth is None:
-            depth = int(os.environ.get("REFLOW_WINDOW_DEPTH", "2"))
+            depth = env_int("REFLOW_WINDOW_DEPTH")
         staged = (self.megatick
                   and getattr(sched, "stage_window", None) is not None)
         self.depth = max(1, int(depth)) if staged else 1
@@ -179,7 +181,9 @@ class IngestFrontend:
         #: latch) — never mutated concurrently.
         self._inflight: Deque[_InflightWindow] = deque()
         self._crash = crash
-        self._lock = lock if lock is not None else threading.Lock()
+        self._lock = (lock if lock is not None
+                      else named_lock(f"serve.frontend.{name}" if name
+                                      else "serve.frontend"))
         self._not_full = threading.Condition(self._lock)   # producers
         self._work = (work if work is not None
                       else threading.Condition(self._lock))  # pump

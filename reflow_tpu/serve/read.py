@@ -32,6 +32,7 @@ import threading
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
 from reflow_tpu.obs.registry import REGISTRY
+from reflow_tpu.utils.runtime import named_lock
 
 __all__ = ["ReadTier", "LeaderReadAdapter", "StaleRead", "ReadResult"]
 
@@ -62,7 +63,7 @@ class LeaderReadAdapter:
     def __init__(self, sched, *, tick=None) -> None:
         self.sched = sched
         self._tick = tick if tick is not None else (lambda: sched._tick)
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.read.leader")
 
     def published_horizon(self) -> int:
         return self._tick()
@@ -114,7 +115,7 @@ class ReadTier:
         self.leader = leader
         self._replicas: List[object] = list(replicas)
         self._rr = itertools.count()
-        self._lock = threading.Lock()
+        self._lock = named_lock(f"serve.read.{name}")
         self.replica_reads = 0
         self.leader_fallbacks = 0
         self.stale_reads = 0

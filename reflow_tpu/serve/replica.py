@@ -54,6 +54,7 @@ import numpy as np
 from reflow_tpu.obs import trace as _trace
 from reflow_tpu.obs.registry import REGISTRY
 from reflow_tpu.scheduler import DirtyScheduler
+from reflow_tpu.utils.runtime import named_lock
 from reflow_tpu.wal.log import (_MAGIC, LogPosition, WalError, _repair_tail,
                                 _seg_path, list_segments)
 from reflow_tpu.wal.recovery import replay_records
@@ -102,7 +103,7 @@ class ReplicaScheduler:
         self.name = name or (os.path.basename(os.path.normpath(replica_dir))
                              or "replica")
         self.sched = DirtyScheduler(graph, executor)
-        self._lock = threading.RLock()
+        self._lock = named_lock(f"serve.replica.{self.name}", reentrant=True)
         #: parsed-but-unapplied records (the holdback buffer): entries
         #: are (pos, end_pos, record); only a suffix past the last
         #: applied tick marker ever lives here
@@ -311,6 +312,7 @@ class ReplicaScheduler:
             with open(tmp, "wb") as f:
                 pickle.dump(meta, f)
                 f.flush()
+                # reflow-lint: waive lock-blocking-call -- checkpoint-meta fsync on the replica's own apply thread; readers never park on this lock mid-read (horizon snapshot is taken before)
                 os.fsync(f.fileno())
             os.replace(tmp, meta_path)
             self._persist_cursor()

@@ -87,7 +87,10 @@ class Ticket:
                 f"ticket {self.batch_id!r} unresolved after {timeout}s")
         if self._error is not None:
             raise self._error
-        assert self._result is not None
+        if self._result is None:
+            raise RuntimeError(
+                f"ticket {self.batch_id!r} resolved with neither result "
+                f"nor error")
         return self._result
 
     # -- frontend side -----------------------------------------------------

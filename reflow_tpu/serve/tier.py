@@ -52,6 +52,7 @@ from collections import deque
 from typing import Deque, Dict, List, Optional
 
 from reflow_tpu.graph import GraphError
+from reflow_tpu.utils.runtime import named_lock
 from reflow_tpu.obs import trace as _trace
 
 from .budget import AdmissionBudget
@@ -212,7 +213,7 @@ class ServeTier:
                 f"pump_threads must be positive, got {pump_threads}")
         self.quantum_rows = quantum_rows
         self._crash = crash
-        self._lock = threading.Lock()
+        self._lock = named_lock("serve.tier")
         #: the pool's (and every frontend's) work condition: producers
         #: notify on admit, workers notify on window finish
         self._work = threading.Condition(self._lock)
@@ -370,6 +371,7 @@ class ServeTier:
             reg.unregister_source(key)
             reg.unregister_prefix(f"{key}.")
         self._metric_keys = []
+        self.budget.unpublish_metrics()
 
     def __enter__(self) -> "ServeTier":
         return self

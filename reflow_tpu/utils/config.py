@@ -1,23 +1,251 @@
-"""Config/flag system (SURVEY.md §5): one dataclass, one env mapping.
+"""Config/flag system (SURVEY.md §5): one dataclass, one env registry.
 
-The load-bearing flag is the executor choice (cpu | tpu | sharded |
-staged — SURVEY.md §5 names it explicitly); the rest are the scheduler
-knobs every entry point was already threading by hand. ``from_env`` reads
-the ``REFLOW_*`` environment (the convention bench.py established), so a
-driver can flip the executor or loop bounds without code changes::
+Two layers live here:
 
-    cfg = ReflowConfig.from_env()          # REFLOW_EXECUTOR=sharded ...
-    sched = cfg.scheduler(graph)
+- :class:`ReflowConfig` — the load-bearing executor choice plus the
+  scheduler knobs every entry point was already threading by hand
+  (``from_env`` reads the ``REFLOW_*`` environment so a driver can flip
+  the executor or loop bounds without code changes).
+- the **knob registry** — every ``REFLOW_*`` environment variable the
+  project reads is :func:`declare`-d here once, with its type, default
+  and a one-line docstring, and read through the typed accessors
+  (:func:`env_flag` / :func:`env_int` / :func:`env_float` /
+  :func:`env_str`). ``tools/reflow_lint.py``'s env-knob pass enforces
+  the funnel: a literal ``os.environ.get("REFLOW_...")`` anywhere else
+  in the tree is a lint finding, an accessor read of an undeclared name
+  raises :class:`KeyError` at runtime, and every declared knob must
+  appear in docs/guide.md's knob catalog.
+
+Why a funnel: six serving-tier PRs accreted ~50 knobs read at ~40 call
+sites; an operator had no single place to discover them and a typo'd
+name silently read its default forever. Now discovery is
+``python -c "from reflow_tpu.utils.config import knob_table;
+print(knob_table())"`` and typos fail loudly.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import os
-from typing import Optional
+from typing import Dict, Optional
 
-__all__ = ["ReflowConfig"]
+__all__ = ["Knob", "KNOBS", "ReflowConfig", "declare", "env_flag",
+           "env_float", "env_int", "env_str", "knob_table"]
 
+
+# -- knob registry ----------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One declared environment knob: its type tag (``flag`` / ``int``
+    / ``float`` / ``str``), documented default, and one-line doc."""
+
+    name: str
+    kind: str
+    default: object
+    doc: str
+
+
+#: name -> Knob for every REFLOW_* variable the project reads
+KNOBS: Dict[str, Knob] = {}
+
+_KINDS = ("flag", "int", "float", "str")
+_UNSET = object()
+
+
+def declare(name: str, kind: str, default, doc: str) -> str:
+    """Register one knob (module import time). Idempotent re-declares
+    with identical fields are allowed (reload safety); a conflicting
+    re-declare raises."""
+    if kind not in _KINDS:
+        raise ValueError(f"knob kind {kind!r} not in {_KINDS}")
+    if not name.startswith("REFLOW_"):
+        raise ValueError(f"knob {name!r} must start with REFLOW_")
+    prev = KNOBS.get(name)
+    k = Knob(name, kind, default, doc)
+    if prev is not None and prev != k:
+        raise ValueError(f"knob {name!r} re-declared with different "
+                         f"fields: {prev} vs {k}")
+    KNOBS[name] = k
+    return name
+
+
+def _raw(name: str, env) -> Optional[str]:
+    if name not in KNOBS:
+        raise KeyError(
+            f"{name!r} is not a declared knob; declare() it in "
+            f"reflow_tpu/utils/config.py (docs/guide.md 'Environment "
+            f"knobs')")
+    v = (os.environ if env is None else env).get(name)
+    return None if v is None or v == "" else v
+
+
+def env_flag(name: str, default=_UNSET, *, env=None) -> bool:
+    """Boolean knob: unset/empty -> default; else any value but "0" is
+    True (so ``REFLOW_X=1`` enables, ``REFLOW_X=0`` disables)."""
+    v = _raw(name, env)
+    if v is None:
+        d = KNOBS[name].default if default is _UNSET else default
+        return bool(d)
+    return v != "0"
+
+
+def env_int(name: str, default=_UNSET, *, env=None) -> Optional[int]:
+    v = _raw(name, env)
+    if v is None:
+        d = KNOBS[name].default if default is _UNSET else default
+        return None if d is None else int(d)
+    return int(v)
+
+
+def env_float(name: str, default=_UNSET, *, env=None) -> Optional[float]:
+    v = _raw(name, env)
+    if v is None:
+        d = KNOBS[name].default if default is _UNSET else default
+        return None if d is None else float(d)
+    return float(v)
+
+
+def env_str(name: str, default=_UNSET, *, env=None) -> Optional[str]:
+    v = _raw(name, env)
+    if v is None:
+        d = KNOBS[name].default if default is _UNSET else default
+        return None if d is None else str(d)
+    return v
+
+
+def knob_table() -> str:
+    """The knob catalog as a markdown table (docs/guide.md embeds the
+    same rows; the lint's env-knob pass keeps them in sync by name)."""
+    rows = ["| knob | type | default | what it does |",
+            "|---|---|---|---|"]
+    for k in sorted(KNOBS.values(), key=lambda k: k.name):
+        rows.append(f"| `{k.name}` | {k.kind} | `{k.default}` | "
+                    f"{k.doc} |")
+    return "\n".join(rows)
+
+
+# -- core runtime knobs -----------------------------------------------------
+
+declare("REFLOW_EXECUTOR", "str", "cpu",
+        "executor registry name: cpu (oracle) / tpu / sharded / staged")
+declare("REFLOW_MAX_LOOP_ITERS", "int", 10_000,
+        "fixpoint pass bound per tick (DirtyScheduler.max_loop_iters)")
+declare("REFLOW_DEDUP_WINDOW", "int", 1 << 20,
+        "idempotent-push dedup horizon (batch ids remembered)")
+declare("REFLOW_MESH_DEVICES", "int", None,
+        "mesh size for the sharded executor (unset = all local devices)")
+declare("REFLOW_LINEAR_FIXPOINT", "flag", True,
+        "fused delta-vector loop on tpu/sharded executors (0 disables)")
+declare("REFLOW_WINDOW_DEPTH", "int", 2,
+        "pipelined window depth (1 = serial stage->dispatch->retire)")
+declare("REFLOW_MEGATICK_WASTE", "float", 0.5,
+        "max padded-slot fraction before a fused window falls back")
+declare("REFLOW_MEGATICK_MAX_ROWS", "int", 1 << 16,
+        "max rows per fused mega-tick window before fallback")
+declare("REFLOW_TOPK_PALLAS", "str", None,
+        "force the Pallas top-k kernel on (1) or off (0); unset = "
+        "auto-detect")
+declare("REFLOW_LOCKCHECK", "flag", False,
+        "wrap named locks with the runtime lock-order detector; a "
+        "held-before cycle raises LockOrderError (docs/guide.md "
+        "'Static analysis & lockcheck')")
+
+# -- observability ----------------------------------------------------------
+
+declare("REFLOW_TRACE", "flag", False,
+        "enable per-ticket trace spans at import time (obs.enable())")
+declare("REFLOW_TRACE_RING", "int", 65536,
+        "per-thread trace ring-buffer capacity (spans)")
+declare("REFLOW_TRACE_SAMPLE", "int", 16,
+        "ticket sampling stride: 1-in-N tickets get a span timeline")
+declare("REFLOW_TRACE_OUT", "str", None,
+        "chrome-trace export path (bench obs mode / export default)")
+
+# -- bench protocol ---------------------------------------------------------
+
+declare("REFLOW_BENCH_ALL", "flag", True,
+        "run the full config sweep in the default bench mode "
+        "(0 = config-3 only)")
+declare("REFLOW_BENCH_SMOKE", "flag", False,
+        "CI-scale every bench mode (small graphs, short windows)")
+declare("REFLOW_BENCH_CHILD", "str", None,
+        "internal: which single config a bench child process runs")
+declare("REFLOW_BENCH_NODES", "int", None,
+        "pagerank bench graph nodes (default 100k, smoke 1k)")
+declare("REFLOW_BENCH_EDGES", "int", None,
+        "pagerank bench graph edges (default 1M, smoke 10k)")
+declare("REFLOW_BENCH_CHURN", "float", 0.01,
+        "per-tick churn fraction in the streaming benches")
+declare("REFLOW_BENCH_STREAM_TICKS", "int", None,
+        "pipelined window length (default 16, smoke 4)")
+declare("REFLOW_BENCH_CPU_FULL", "flag", False,
+        "run the CPU oracle at full scale instead of the capped sweep")
+declare("REFLOW_BENCH_CPU_EDGES_CAP", "int", None,
+        "CPU oracle measured at <= this many edges (default 200k)")
+declare("REFLOW_BENCH_DEFER", "str", "1",
+        "deferred-fixpoint mode for the bench loop (1/0/auto)")
+declare("REFLOW_BENCH_TRACE", "str", None,
+        "directory for an xprof device trace of one churn tick")
+declare("REFLOW_BENCH_MODEL_AXIS", "int", 0,
+        "model-parallel axis size for the image_embed config")
+declare("REFLOW_BENCH_IMG_PER_TICK", "int", 256,
+        "image_embed bench: images folded per tick")
+declare("REFLOW_BENCH_KNN_DTYPE", "str", "int8",
+        "knn bench wire dtype for document uploads")
+declare("REFLOW_BENCH_KNN_SETTLE", "int", 60,
+        "knn bench settle ticks before measuring")
+declare("REFLOW_BENCH_KNN_PRELOAD", "int", None,
+        "knn bench preloaded document count cap")
+declare("REFLOW_BENCH_RECOVERY", "flag", False,
+        "bench mode: WAL crash-recovery walls")
+declare("REFLOW_BENCH_RECOVERY_TICKS", "int", 1000,
+        "recovery bench crash-backlog size (ticks)")
+declare("REFLOW_BENCH_RECOVERY_TPU_TICKS", "int", None,
+        "recovery bench device-path backlog (default backlog/10)")
+declare("REFLOW_BENCH_SERVE", "flag", False,
+        "bench mode: streaming ingestion frontend throughput")
+declare("REFLOW_BENCH_SERVE_BATCHES", "int", None,
+        "serve bench micro-batches per producer (default 250, smoke 40)")
+declare("REFLOW_BENCH_TIER", "flag", False,
+        "bench mode: multi-graph serving tier")
+declare("REFLOW_BENCH_TIER_BATCHES", "int", None,
+        "tier bench micro-batches per producer (default 200, smoke 30)")
+declare("REFLOW_BENCH_CONTROL", "flag", False,
+        "bench mode: control-plane step-load surge/heal")
+declare("REFLOW_BENCH_OBS", "flag", False,
+        "bench mode: tracing + telemetry overhead and decomposition")
+declare("REFLOW_BENCH_OBS_BATCHES", "int", None,
+        "obs bench micro-batches per producer (default 250, smoke 40)")
+declare("REFLOW_BENCH_WALPIPE", "flag", False,
+        "bench mode: asynchronous durability pipeline")
+declare("REFLOW_BENCH_WALPIPE_BATCHES", "int", None,
+        "walpipe bench batches per producer at 16p (default 4, smoke 2)")
+declare("REFLOW_BENCH_MEGATICK", "flag", False,
+        "bench mode: compiled mega-tick windows vs the per-tick twin")
+declare("REFLOW_BENCH_PIPELINE", "flag", False,
+        "bench mode: pipelined window execution depth 2 vs depth 1")
+declare("REFLOW_BENCH_SHARDSERVE", "flag", False,
+        "bench mode: pod-scale spread/sharded serving")
+declare("REFLOW_BENCH_SHARDSERVE_BATCHES", "int", None,
+        "shardserve bench batches per producer (default 48, smoke 8)")
+declare("REFLOW_BENCH_REPLICA", "flag", False,
+        "bench mode: WAL shipping + read-replica scaling")
+declare("REFLOW_BENCH_REPLICA_N", "int", 4,
+        "replica bench follower count")
+declare("REFLOW_BENCH_REPLICA_READ_S", "float", None,
+        "replica bench per-leg read window seconds (default 2.0, "
+        "smoke 0.6)")
+declare("REFLOW_BENCH_FAILOVER", "flag", False,
+        "bench mode: leader kill + epoch-fenced promotion")
+declare("REFLOW_BENCH_FAILOVER_N", "int", 2,
+        "failover bench follower count")
+declare("REFLOW_BENCH_FAILOVER_RUN_S", "float", None,
+        "failover bench per-phase write window seconds (default 1.0, "
+        "smoke 0.3)")
+
+
+# -- the config dataclass ---------------------------------------------------
 
 @dataclasses.dataclass(frozen=True)
 class ReflowConfig:
@@ -34,14 +262,13 @@ class ReflowConfig:
     linear_fixpoint: bool = True
 
     @staticmethod
-    def from_env(env=os.environ) -> "ReflowConfig":
-        md = env.get("REFLOW_MESH_DEVICES")
+    def from_env(env=None) -> "ReflowConfig":
         return ReflowConfig(
-            executor=env.get("REFLOW_EXECUTOR", "cpu"),
-            max_loop_iters=int(env.get("REFLOW_MAX_LOOP_ITERS", 10_000)),
-            dedup_window=int(env.get("REFLOW_DEDUP_WINDOW", 1 << 20)),
-            mesh_devices=int(md) if md else None,
-            linear_fixpoint=env.get("REFLOW_LINEAR_FIXPOINT", "1") != "0",
+            executor=env_str("REFLOW_EXECUTOR", env=env),
+            max_loop_iters=env_int("REFLOW_MAX_LOOP_ITERS", env=env),
+            dedup_window=env_int("REFLOW_DEDUP_WINDOW", env=env),
+            mesh_devices=env_int("REFLOW_MESH_DEVICES", env=env),
+            linear_fixpoint=env_flag("REFLOW_LINEAR_FIXPOINT", env=env),
         )
 
     def make_executor(self):

@@ -31,6 +31,7 @@ import numpy as np
 
 from reflow_tpu.delta import DeltaBatch
 from reflow_tpu.graph import Node
+from reflow_tpu.utils.runtime import named_lock
 
 __all__ = ["CrashInjector", "CrashPoint", "DeliveryError", "FaultyChannel",
            "StormInjector", "tear_wal_tail"]
@@ -79,7 +80,7 @@ class CrashInjector:
         self.fired = False
         self.fired_seam: Optional[str] = None
         self.seams: List[str] = []
-        self._lock = threading.Lock()
+        self._lock = named_lock("faults.crash")
 
     def point(self, name: str) -> None:
         with self._lock:
@@ -111,7 +112,7 @@ class StormInjector:
         self.armed = True
         self.crashes = 0
         self.seams: List[str] = []
-        self._lock = threading.Lock()
+        self._lock = named_lock("faults.storm")
 
     def point(self, name: str) -> None:
         with self._lock:

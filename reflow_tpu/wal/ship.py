@@ -51,6 +51,7 @@ import zlib
 from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 from reflow_tpu.obs import trace as _trace
+from reflow_tpu.utils.runtime import named_lock
 from reflow_tpu.obs.registry import REGISTRY
 from reflow_tpu.wal.log import (_HEADER, _MAGIC, LogPosition, WalError,
                                 list_segments)
@@ -194,7 +195,7 @@ class SegmentShipper:
         self._leader_tick = leader_tick or (lambda: 0)
         self.poll_s = poll_s
         self.max_chunk_bytes = max(int(max_chunk_bytes), 1 << 10)
-        self._lock = threading.Lock()
+        self._lock = named_lock("wal.ship")
         self._followers: Dict[str, _FollowerState] = {}
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()

@@ -402,6 +402,52 @@ def test_pump_crash_fails_tickets_and_closes_frontend():
     fe.close()                      # still clean to close
 
 
+def test_producer_submit_crash_dies_in_submitting_thread():
+    """producer_submit is a PRODUCER-thread seam: the kill surfaces out
+    of submit() itself, before any frontend state mutates — the pump
+    survives and the next submit applies normally."""
+    crash = CrashInjector(1, only="producer_submit")
+    fe, _sched, src, _sink = make_frontend(crash=crash)
+    with pytest.raises(CrashPoint):
+        fe.submit(src, lines_batch("a"))
+    assert crash.fired and crash.fired_seam == "producer_submit"
+    assert fe.submitted == 0 and fe.pump_error is None
+    r = fe.submit(src, lines_batch("a")).result(timeout=5)
+    assert r.applied
+    fe.close()
+
+
+def test_producer_admitted_crash_batch_survives_and_resend_dedups():
+    """producer_admitted fires AFTER the batch is queued and its id
+    noted: the producer dies, but the pump still applies the batch, and
+    the upstream's resend (it cannot know the fate) dedups — the
+    exactly-once story for a producer killed mid-return."""
+    crash = CrashInjector(1, only="producer_admitted")
+    fe, sched, src, sink = make_frontend(crash=crash)
+    with pytest.raises(CrashPoint):
+        fe.submit(src, lines_batch("a"), batch_id="k0")
+    assert crash.fired and crash.fired_seam == "producer_admitted"
+    r = fe.submit(src, lines_batch("a"), batch_id="k0").result(timeout=5)
+    assert r.status == DEDUPED
+    fe.flush()
+    fe.close()
+    assert dict(sched.view(sink.name)) == {("a", 1.0): 1}
+
+
+def test_pump_coalesce_crash_fails_window_tickets():
+    """pump_coalesce cuts between the host-side merge and everything
+    durable/device-side: the whole drained window's tickets must fail
+    PumpCrashed (nothing was pushed, so nothing half-applied)."""
+    crash = CrashInjector(1, only="pump_coalesce")
+    fe, _sched, src, _sink = make_frontend(crash=crash)
+    t = fe.submit(src, lines_batch("a"))
+    with pytest.raises(PumpCrashed):
+        t.result(timeout=5)
+    assert crash.fired and crash.fired_seam == "pump_coalesce"
+    assert isinstance(fe.pump_error, CrashPoint)
+    fe.close()
+
+
 def test_durable_pump_crash_then_recover_exactly_once(tmp_path):
     """The acceptance differential: kill the pump mid-stream on a
     durable scheduler, recover a fresh one, re-send EVERYTHING (the

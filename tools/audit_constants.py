@@ -1,159 +1,19 @@
 """Audit every compiled program for multi-element HLO constants.
 
-The remote-device (tunnel) runtime degrades process-wide — every
-subsequent dispatch pays ~88ms, permanently — after executing any
-program whose HLO carries a constant with >= 2 elements (measured:
-splat s32[4] poisons; scalar and 1-element constants do not). This
-audit runs each benchmark workload at tiny scale on the CPU backend
-with XLA HLO dumps enabled and reports every multi-element constant
-per program, so no such literal ever ships in a hot-path program.
+Shim: the audit now lives in ``reflow_tpu/analysis/constants.py`` and
+runs as reflow-lint's opt-in slow pass (``python tools/reflow_lint.py
+--hlo``). This entry point keeps the historical CLI working.
 
 Usage: python tools/audit_constants.py [workload ...]
 Exit code 1 if any multi-element constant is found.
 """
-import glob
 import os
-import re
-import shutil
-import subprocess
 import sys
 
-WORKLOADS = ("pagerank", "tfidf", "knn", "image_embed", "sharded_pagerank",
-             "minmax")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-_CHILD = r'''
-import os, sys
-import numpy as np
-import jax
-jax.config.update("jax_platforms", "cpu")
-sys.path.insert(0, "@REPO@")
-from reflow_tpu.executors import get_executor
-from reflow_tpu.scheduler import DirtyScheduler
-
-w = "@WORKLOAD@"
-if w == "pagerank":
-    from bench import _build_pagerank
-    from reflow_tpu.workloads import pagerank
-    pr, web = _build_pagerank(2_000, 20_000, 0.01, 1e-4)
-    sched = DirtyScheduler(pr.graph, get_executor("tpu"))
-    sched.push(pr.teleport, pagerank.teleport_batch(2_000))
-    sched.push(pr.edges, web.initial_batch())
-    sched.tick()
-    sched.push(pr.edges, web.churn(0.01))
-    sched.tick()
-elif w == "sharded_pagerank":
-    from reflow_tpu.parallel import make_mesh
-    from reflow_tpu.parallel.shard import ShardedTpuExecutor
-    from reflow_tpu.workloads import pagerank
-    N, E = 2_048, 16_384
-    pg = pagerank.build_graph(N, tol=1e-4, arena_capacity=1 << 18)
-    web = pagerank.WebGraph.random(N, E, seed=11)
-    sched = DirtyScheduler(pg.graph, ShardedTpuExecutor(make_mesh()))
-    sched.push(pg.teleport, pagerank.teleport_batch(N))
-    sched.push(pg.edges, web.initial_batch())
-    sched.tick()
-    sched.push(pg.edges, web.churn(0.01))
-    sched.tick()
-elif w == "tfidf":
-    from reflow_tpu.workloads import tfidf
-    n_pairs, n_terms, n_docs = 1 << 12, 1 << 10, 64
-    corpus = tfidf.Corpus(n_pairs, n_terms)
-    tg = tfidf.build_graph(n_pairs, n_terms, n_docs)
-    sched = DirtyScheduler(tg.graph, get_executor("tpu"))
-    rng = np.random.default_rng(1)
-    words = np.array([f"t{i}" for i in range(500)])
-    def text():
-        return " ".join(rng.choice(words, size=rng.integers(20, 60)))
-    from reflow_tpu.delta import DeltaBatch
-    sched.push(tg.tokens, DeltaBatch.concat(
-        [corpus.edit(d, text()) for d in range(8)]))
-    sched.tick()
-    for i in range(3):
-        sched.push(tg.tokens, corpus.edit(i, text()))
-        sched.tick()
-elif w == "knn":
-    from reflow_tpu.workloads import knn
-    from reflow_tpu.delta import DeltaBatch
-    Q, D, dim, k, chunk = 16, 4096, 32, 4, 1024
-    kg = knn.build_graph(Q, D, dim, k, scan_chunk=chunk)
-    store = knn.EmbeddingStore.create(dim, seed=3)
-    sched = DirtyScheduler(kg.graph, get_executor("tpu"))
-    qvecs = store._random(Q)
-    sched.push(kg.queries, DeltaBatch(
-        np.arange(Q, dtype=np.int64), qvecs, np.ones(Q, np.int64)))
-    sched.push(kg.docs, store.insert_batch(np.arange(256)))
-    sched.tick()
-    sched.push(kg.docs, store.insert_batch(np.arange(256, 320)))
-    sched.tick()
-    sched.push(kg.docs, store.retract_batch(np.arange(8)))
-    sched.tick()
-elif w == "minmax":
-    from reflow_tpu.delta import DeltaBatch, Spec
-    from reflow_tpu.graph import FlowGraph
-    g = FlowGraph("mm")
-    spec = Spec((), np.float32, key_space=64)
-    s = g.source("s", spec)
-    g.sink(g.reduce(s, "min", name="lo", candidates=8), "out")
-    sched = DirtyScheduler(g, get_executor("tpu"))
-    rng = np.random.default_rng(2)
-    rows = [(int(rng.integers(0, 64)), float(rng.integers(0, 9)), 1)
-            for _ in range(80)]
-    def push(rs):
-        sched.push(s, DeltaBatch(np.array([r[0] for r in rs]),
-                                 np.array([r[1] for r in rs], np.float32),
-                                 np.array([r[2] for r in rs])))
-        sched.tick()
-    push(rows)
-    push([(k, v, -w) for k, v, w in rows[:20]])
-elif w == "image_embed":
-    from reflow_tpu.models import VIT_TINY, init_vit
-    from reflow_tpu.workloads import image_embed
-    params = init_vit(0, **VIT_TINY)
-    params["_cfg"] = VIT_TINY
-    ig = image_embed.build_graph(256, 4, params)
-    sched = DirtyScheduler(ig.graph, get_executor("tpu"))
-    stream = image_embed.ImageStream(params, seed=5)
-    ids = np.arange(8)
-    sched.push(ig.images, stream.insert(ids, ids % 4))
-    sched.tick()
-    ids2 = np.arange(8, 16)
-    sched.push(ig.images, stream.insert(ids2, ids2 % 4))
-    sched.tick()
-print("CHILD_OK")
-'''
-
-PAT = re.compile(r"=\s*([a-z0-9]+)\[([\d,]+)\]\S*\s+constant\(")
-
-
-def audit(workload: str, repo: str) -> list:
-    dump = f"/tmp/const_audit_{workload}"
-    shutil.rmtree(dump, ignore_errors=True)
-    env = dict(os.environ)
-    env["XLA_FLAGS"] = f"--xla_dump_to={dump} --xla_dump_hlo_as_text"
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PYTHONPATH"] = repo
-    if workload == "sharded_pagerank":
-        env["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
-    child = _CHILD.replace("@REPO@", repo).replace("@WORKLOAD@", workload)
-    r = subprocess.run([sys.executable, "-c", child],
-                       capture_output=True, text=True, env=env, timeout=900)
-    if "CHILD_OK" not in r.stdout:
-        return [("CHILD_FAILED", r.stderr.strip().splitlines()[-3:])]
-    bad = []
-    for f in sorted(glob.glob(f"{dump}/*before_optimizations*.txt")):
-        mod = os.path.basename(f).split(".")[1]
-        for line in open(f):
-            m = PAT.search(line)
-            if not m:
-                continue
-            dims = [int(d) for d in m.group(2).split(",")]
-            n = 1
-            for d in dims:
-                n *= d
-            if n >= 2:
-                bad.append((mod, f"{m.group(1)}{dims}",
-                            line.strip()[:100]))
-    return bad
+from reflow_tpu.analysis.constants import WORKLOADS, audit  # noqa: E402
 
 
 def main() -> int:
@@ -162,7 +22,8 @@ def main() -> int:
     fail = False
     for w in targets:
         bad = audit(w, repo)
-        status = "CLEAN" if not bad else f"{len(bad)} multi-element constants"
+        status = ("CLEAN" if not bad
+                  else f"{len(bad)} multi-element constants")
         print(f"{w}: {status}")
         for item in bad:
             print("  " + "  ".join(str(x) for x in item))

@@ -34,6 +34,8 @@ import time
 
 import numpy as np
 
+from reflow_tpu.utils.config import env_flag
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -52,7 +54,7 @@ def main():
     from reflow_tpu.scheduler import DirtyScheduler
     from reflow_tpu.workloads import pagerank
 
-    smoke = os.environ.get("REFLOW_BENCH_SMOKE") == "1"
+    smoke = env_flag("REFLOW_BENCH_SMOKE")
     n_nodes = 1_000 if smoke else 100_000
     n_edges = 10_000 if smoke else 1_000_000
     churn = 0.01

@@ -16,6 +16,11 @@ if python -c "import pyflakes" 2>/dev/null; then
   python -m pyflakes reflow_tpu bench.py bench_configs.py \
     || { echo "TIER1: pyflakes failed"; exit 2; }
 fi
+# reflow-lint: the project's own invariant checker (lock discipline,
+# seam hygiene, metrics pairing, env-knob registry, exception policy).
+# AST-only — seconds, no jax import. docs/guide.md has the rule catalog.
+python tools/reflow_lint.py \
+  || { echo "TIER1: reflow-lint found violations"; exit 2; }
 
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
@@ -33,6 +38,20 @@ FLOOR=${TIER1_FLOOR:-395}
 if [ "$dots" -lt "$FLOOR" ]; then
   echo "TIER1: DOTS_PASSED=$dots below floor $FLOOR"
   rc=4
+fi
+
+# optional (RUN_BENCH=1): the lockcheck smoke — re-run the concurrent
+# suites (serve/tier/failover: producers, pump pools, shippers,
+# failover coordinator) with the runtime lock-order monitor armed.
+# Every named_lock acquisition feeds the held-before graph; ANY cycle
+# raises LockOrderError and fails the run. The static twin is the
+# reflow-lint lock pass above; this leg catches the orders the AST
+# can't see (callbacks, cross-module call chains).
+if [ "${RUN_BENCH:-0}" = "1" ] && [ $rc -eq 0 ]; then
+  REFLOW_LOCKCHECK=1 JAX_PLATFORMS=cpu timeout -k 10 600 \
+    python -m pytest tests/test_serve.py tests/test_tier.py \
+    tests/test_failover.py -q -m 'not slow' -p no:cacheprovider \
+    || { echo "TIER1: lockcheck smoke failed"; rc=3; }
 fi
 
 # optional (RUN_BENCH=1): the serve-mode smoke — sustained ingestion
