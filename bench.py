@@ -141,6 +141,20 @@ Env knobs::
                                   promotion horizon (CPU-only)
     REFLOW_BENCH_FAILOVER_N       follower count            (default 2)
     REFLOW_BENCH_FAILOVER_RUN_S   per-phase write window (s) (default 1.0)
+    REFLOW_BENCH_CHAOS=1          chaos-soak mode instead: ship the WAL
+                                  to N replicas over REAL TCP links, each
+                                  wrapped in a seeded fault injector
+                                  (drop/dup/reorder/corrupt/delay, a
+                                  scripted one-way partition + reset),
+                                  then quiesce and kill the leader;
+                                  asserts zero acked-write loss, exact
+                                  view parity at equal horizons, lag <=
+                                  one commit window after faults stop,
+                                  and that the fenced ex-leader's
+                                  post-fence shipments are all NACKed
+                                  (CPU-only)
+    REFLOW_BENCH_CHAOS_N          follower count            (default 3)
+    REFLOW_BENCH_CHAOS_RUN_S      write window (s)          (default 1.2)
     REFLOW_TRACE_OUT              obs-mode chrome trace path
                                   (default /tmp/reflow_obs_trace.json)
 
@@ -1547,6 +1561,355 @@ def run_failover_bench() -> dict:
     return out
 
 
+# -- chaos-soak mode (REFLOW_BENCH_CHAOS=1) --------------------------------
+
+def run_chaos_bench() -> dict:
+    """Replication-over-the-wire chaos soak (docs/guide.md
+    "Replication over the wire"): a wordcount leader under sustained
+    16-producer writes ships its WAL to N replicas over REAL TCP
+    links, every link wrapped in a seeded :class:`WireFaults` /
+    ``FaultyTransport`` pair, while a scripted schedule runs:
+
+    A. **probabilistic storm** — drop (both directions), duplicate,
+       reorder, frame corruption, payload corruption, delay on every
+       link, under full write load;
+    B. **scripted faults** — a one-way partition on the last link
+       (driven to ``unreachable``, ejected from the read tier) and a
+       connection reset on the first (forcing the reconnect path);
+    C. **quiesce** — all faults stop; replicas must converge to lag
+       <= one commit window within a bounded wall;
+    D. **leader kill** — the last link is re-partitioned (so the
+       ex-leader keeps undrained bytes for it), the committer is
+       killed mid-fsync, and the coordinator runs the epoch-fenced
+       promotion; after healing, the ex-leader's shipper is pumped at
+       the re-anchored replicas and every shipment it offers must be
+       NACKed ``fenced:`` — acked zero times, merged never.
+
+    Producers use fixed batch ids with resubmit-until-acked, so the
+    final zero-loss check is exact: the new leader's view equals a
+    fresh fold of every acked batch, and every surviving replica's
+    view at the shared horizon equals the new leader's with
+    ``max_abs_diff == 0``.
+
+    Host-side CPU work; runs on the CPU executor/platform."""
+    import shutil
+    import tempfile
+    import threading
+
+    from reflow_tpu.net import (FaultyTransport, ReconnectPolicy,
+                                RemoteFollower, ReplicaServer,
+                                TcpTransport)
+    from reflow_tpu.obs import REGISTRY
+    from reflow_tpu.serve import (CoalesceWindow, FailoverCoordinator,
+                                  IngestFrontend, LeaderReadAdapter,
+                                  ReadTier, ReplicaScheduler)
+    from reflow_tpu.utils.faults import CrashInjector, WireFaults
+    from reflow_tpu.wal import DurableScheduler, SegmentShipper
+    from reflow_tpu.workloads import wordcount
+
+    smoke = env_flag("REFLOW_BENCH_SMOKE")
+    n_replicas = max(2, env_int("REFLOW_BENCH_CHAOS_N", "3"))
+    n_producers = 16
+    window_ticks = 4
+    vocab = 2_000 if smoke else 20_000
+    run_s = env_float("REFLOW_BENCH_CHAOS_RUN_S", "0.4" if smoke else "1.2")
+    fault_seed = env_int("REFLOW_NET_FAULT_SEED", "0")
+
+    tmp = tempfile.mkdtemp(prefix="reflow-chaos-")
+    out = {"replicas": n_replicas, "producers": n_producers,
+           "window_ticks": window_ticks, "run_s": run_s, "vocab": vocab,
+           "fault_seed": fault_seed}
+    fe = ship = coord = new_sched = None
+    replicas, servers, links, faults = [], [], [], []
+    producers: list = []
+    stop = threading.Event()
+    rebound = threading.Event()
+    try:
+        g, src, sink = wordcount.build_graph()
+        sched = DurableScheduler(g, wal_dir=os.path.join(tmp, "wal"),
+                                 fsync="tick", committer="thread",
+                                 segment_bytes=1 << 20)
+        fe = IngestFrontend(sched, window=CoalesceWindow(
+            max_rows=65536, max_ticks=window_ticks, max_latency_s=0.002))
+        ship = SegmentShipper(sched.wal, leader_tick=lambda: sched._tick,
+                              poll_s=0.001)
+        for i in range(n_replicas):
+            gr, _s, _k = wordcount.build_graph()
+            r = ReplicaScheduler(gr, os.path.join(tmp, f"r{i}"),
+                                 name=f"r{i}")
+            srv = ReplicaServer(r, TcpTransport()).start()
+            # born quiet so attach()'s subscribe handshake lands; the
+            # storm switches on (set_rates) once producers are running
+            wf = WireFaults(seed=fault_seed + 17 * i + 1)
+            # fast-recovery policy: bench wall-time, not prod defaults
+            link = RemoteFollower(
+                FaultyTransport(TcpTransport(), wf), srv.address,
+                name=f"r{i}",
+                policy=ReconnectPolicy(f"r{i}", base_s=0.005,
+                                       cap_s=0.05, seed=fault_seed),
+                io_timeout_s=0.05)
+            ship.attach(link)
+            replicas.append(r)
+            servers.append(srv)
+            links.append(link)
+            faults.append(wf)
+        tier = ReadTier(replicas, leader=LeaderReadAdapter(sched))
+        for r, link in zip(replicas, links):
+            tier.bind_link(r, link)
+        ship.publish_metrics()
+        tier.publish_metrics()
+        ship.start()
+
+        parity = {}
+
+        def promote_fn(winner, epoch):
+            ph, pre = winner.view_at(sink.name)
+            ns = winner.promote(epoch=epoch, fsync="tick",
+                                committer="thread")
+            new_view = {kv: w for kv, w in ns.view(sink.name).items()
+                        if w != 0}
+            diff = 0
+            for kv in set(pre) | set(new_view):
+                diff = max(diff, abs(pre.get(kv, 0)
+                                     - new_view.get(kv, 0)))
+            parity.update(horizon=ph, max_abs_diff=diff)
+            return ns
+
+        coord = FailoverCoordinator(
+            replicas, shipper=ship, handle=fe, read_tier=tier,
+            confirm_intervals=2, promote_fn=promote_fn,
+            drain_timeout_s=0.8)
+        coord.publish_metrics()
+
+        # -- sustained writes, fixed ids, resubmit-until-acked
+        acked_lock = threading.Lock()
+        acked: list = []
+        lost = [0]
+
+        def produce(pid):
+            rng = np.random.default_rng(1000 + pid)
+            seq = 0
+            while not stop.is_set():
+                words = " ".join(
+                    f"w{int(x)}" for x in rng.integers(0, vocab, 24))
+                bid = f"p{pid}-{seq}"
+                batch = wordcount.ingest_lines([words])
+                deadline = time.monotonic() + 60
+                ok = False
+                while time.monotonic() < deadline:
+                    try:
+                        res = fe.submit(src, batch,
+                                        batch_id=bid).result(timeout=60)
+                    except Exception:  # noqa: BLE001 - PumpCrashed /
+                        # FrontendClosed mid-failover: wait out the
+                        # rebind, resubmit the SAME id; the WAL dedup
+                        # decides exactly-once
+                        rebound.wait(timeout=30)
+                        time.sleep(0.002)
+                        continue
+                    if res.status in ("applied", "deduped"):
+                        ok = True
+                        break
+                    time.sleep(0.001)
+                if ok:
+                    with acked_lock:
+                        acked.append((bid, words))
+                else:
+                    lost[0] += 1
+                seq += 1
+
+        producers.extend(threading.Thread(target=produce, args=(pid,))
+                         for pid in range(n_producers))
+        for t in producers:
+            t.start()
+
+        # -- phase A: probabilistic storm under load
+        for wf in faults:
+            wf.set_rates(drop_c2s=0.04, drop_s2c=0.04, dup=0.04,
+                         reorder=0.04, corrupt_frame=0.01,
+                         corrupt_payload=0.01, delay_p=0.08,
+                         delay_s=0.002)
+        time.sleep(run_s)
+
+        # -- phase B: scripted one-way partition + connection reset
+        target = n_replicas - 1
+        faults[target].partition("c2s")
+        faults[0].reset_once(1)
+        deadline = time.monotonic() + 10
+        while (links[target].conn_state != "unreachable"
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+        out["partition_conn_state"] = links[target].conn_state
+        # a few routed reads eject the dead-linked replica
+        for _ in range(2 * n_replicas):
+            tier.top_k(sink.name, 5, by="value")
+        out["ejected_during_partition"] = any(
+            r is replicas[target] for r in tier.ejected_replicas)
+        time.sleep(0.1)
+
+        # -- phase C: faults stop; converge to <= one commit window
+        for wf in faults:
+            wf.quiesce()
+        t_quiesce = time.perf_counter()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if max(r.lag_ticks() for r in replicas) <= window_ticks:
+                break
+            time.sleep(0.005)
+        out["converge_s"] = round(time.perf_counter() - t_quiesce, 4)
+        lag_after = max(r.lag_ticks() for r in replicas)
+        out["lag_after_quiesce_ticks"] = lag_after
+        assert lag_after <= window_ticks, \
+            f"lag {lag_after} > one commit window ({window_ticks})"
+        # routed reads probe the healed link back into rotation
+        for _ in range(2 * n_replicas):
+            tier.top_k(sink.name, 5, by="value")
+        out["tier_ejects"] = tier.ejects
+        out["tier_restores"] = tier.restores
+        log(f"chaos: converged {out['converge_s']}s after quiesce "
+            f"(lag {lag_after}), ejects={tier.ejects} "
+            f"restores={tier.restores}")
+
+        # -- phase D: re-partition the last link, kill the leader
+        faults[target].partition("c2s")
+        time.sleep(0.05)  # writes land that the ex-leader can't drain
+        # stop the pump thread: promote_now still drains via pump_once,
+        # and a threadless old shipper means the coordinator's new
+        # shipper starts threadless too — so the partitioned replica
+        # stays BEHIND the old horizon until we pump it, making the
+        # ex-leader's post-fence offer (and its fenced NACK) a
+        # deterministic exchange instead of a race against catch-up
+        ship.stop()
+        sched.wal._crash = CrashInjector(at=1, only="wal_before_fsync")
+        t_kill = time.perf_counter()
+        t_detect = t_promoted = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            t0 = time.perf_counter()
+            acts = coord.step()
+            if any(a["kind"] == "failover_promote" for a in acts):
+                t_detect, t_promoted = t0, time.perf_counter()
+            if coord.promoted and not coord._pending_rebind:
+                break
+            time.sleep(0.002)
+        assert coord.promoted, "failover never fired"
+        rebound.set()
+        new_sched = coord.leader_sched
+        out["detection_s"] = round(t_detect - t_kill, 4)
+        out["promotion_s"] = round(t_promoted - t_detect, 4)
+        out["winner"] = coord.winner.name
+        out["epoch"] = coord.epoch
+        out["drained_bytes"] = coord.drained_bytes
+        out["promotion_parity_max_abs_diff"] = parity.get("max_abs_diff")
+        assert parity.get("max_abs_diff") == 0
+        log(f"chaos: {out['winner']} promoted to epoch {out['epoch']} "
+            f"— detect {out['detection_s']}s, promote "
+            f"{out['promotion_s']}s")
+
+        # the partitioned ex-leader heals and keeps shipping its OLD
+        # epoch at the re-anchored replicas: every offer must be NACKed
+        # fenced, ACKed never (the shipments counter is ACKs only)
+        faults[target].heal()
+        acks_before = ship.shipments
+        deadline = time.monotonic() + 10
+        while ship.fence_nacks == 0 and time.monotonic() < deadline:
+            ship.pump_once()
+            time.sleep(0.005)
+        out["ex_leader_fence_nacks"] = ship.fence_nacks
+        out["ex_leader_post_fence_acks"] = ship.shipments - acks_before
+        assert ship.fence_nacks >= 1, "ex-leader was never fenced"
+        assert ship.shipments == acks_before, \
+            "a post-fence shipment from the ex-leader was ACKed"
+
+        # now let the new epoch's shipper catch the survivors up
+        coord.new_shipper.start()
+
+        # -- keep writing on the new leader, then settle and check
+        time.sleep(run_s / 2)
+        stop.set()
+        for t in producers:
+            t.join()
+        fe.flush()
+        new_sched.wal.sync()
+        survivors = [r for r in replicas if not r.promoted]
+        deadline = time.monotonic() + 60
+        while (any(r.published_horizon() != new_sched._tick
+                   for r in survivors)
+               and time.monotonic() < deadline):
+            time.sleep(0.005)
+
+        # zero acked-write loss: every acked batch folded exactly once
+        assert lost[0] == 0, f"{lost[0]} producer batch(es) gave up"
+        from reflow_tpu.scheduler import DirtyScheduler
+        go, so, ko = wordcount.build_graph()
+        oracle = DirtyScheduler(go)
+        with acked_lock:
+            for bid, words in acked:
+                oracle.push(so, wordcount.ingest_lines([words]),
+                            batch_id=bid)
+        oracle.tick()
+        want = {kv: w for kv, w in oracle.view(ko.name).items() if w != 0}
+        got = {kv: w for kv, w in new_sched.view(sink.name).items()
+               if w != 0}
+        diff = 0
+        for kv in set(want) | set(got):
+            diff = max(diff, abs(want.get(kv, 0) - got.get(kv, 0)))
+        out["acked_batches"] = len(acked)
+        out["acked_loss_max_abs_diff"] = diff
+        assert diff == 0, f"acked-write loss: max_abs_diff={diff}"
+
+        # exact parity at equal horizons on every surviving replica
+        parity_diff = 0
+        for r in survivors:
+            rh, rv = r.view_at(sink.name)
+            assert rh == new_sched._tick, (r.name, rh, new_sched._tick)
+            for kv in set(got) | set(rv):
+                parity_diff = max(
+                    parity_diff, abs(got.get(kv, 0) - rv.get(kv, 0)))
+        out["parity_max_abs_diff"] = parity_diff
+        assert parity_diff == 0
+
+        # wire-level accounting: the storm really exercised the paths
+        out["retransmit_bytes"] = ship.retransmit_bytes
+        out["link_stalls"] = ship.link_stalls
+        out["ship_nacks"] = ship.nacks
+        out["reconnects_total"] = sum(l.reconnects_total for l in links)
+        out["fault_stats"] = {
+            f"r{i}": dict(wf.stats) for i, wf in enumerate(faults)}
+        out["conn_state_gauge"] = REGISTRY.value(
+            "replica.r0.conn_state", "?")
+        assert ship.retransmit_bytes > 0, \
+            "no retransmissions: the WAL-as-retransmit path never ran"
+        assert out["reconnects_total"] >= 1, \
+            "no reconnects: the backoff path never ran"
+        log(f"chaos: {len(acked)} acked batch(es), zero loss, parity "
+            f"diff {parity_diff}; {ship.retransmit_bytes} retransmit "
+            f"byte(s), {out['reconnects_total']} reconnect(s), "
+            f"{ship.nacks} nack(s), fenced ex-leader "
+            f"({ship.fence_nacks} fence nack(s))")
+    finally:
+        # producers must see both events even on an assert mid-flight,
+        # or their non-daemon threads outlive the bench
+        stop.set()
+        rebound.set()
+        for t in producers:
+            t.join(timeout=30)
+        if fe is not None:
+            fe.close()
+        if coord is not None:
+            coord.close()
+        if ship is not None:
+            ship.close()
+        for srv in servers:
+            srv.close()
+        for r in replicas:
+            r.close()
+        if new_sched is not None:
+            new_sched.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 # -- tier / multi-graph serving mode (REFLOW_BENCH_TIER=1) -----------------
 
 def run_tier_bench() -> dict:
@@ -2608,6 +2971,18 @@ def main() -> None:
             "metric": "replica_read_scaling_x",
             "value": out["read_scaling_x"],
             "unit": "x",
+            **out,
+        }, json_out)
+        return
+
+    if env_flag("REFLOW_BENCH_CHAOS"):
+        # chaos mode is host-side CPU work over local TCP — no tunnel
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        out = run_chaos_bench()
+        _emit({
+            "metric": "chaos_converge_s",
+            "value": out["converge_s"],
+            "unit": "s",
             **out,
         }, json_out)
         return

@@ -123,6 +123,7 @@ class FailoverCoordinator:
                  sampler: Optional[Callable[[float], Dict]] = None,
                  promote_fn: Optional[Callable] = None,
                  durable_kw: Optional[Dict] = None,
+                 drain_timeout_s: float = 3.0,
                  name: str = "failover"):
         if confirm_intervals < 1:
             raise ValueError("confirm_intervals must be >= 1")
@@ -134,6 +135,7 @@ class FailoverCoordinator:
             else HighestHorizonElection()
         self.heartbeat_timeout_s = heartbeat_timeout_s
         self.confirm_intervals = confirm_intervals
+        self.drain_timeout_s = drain_timeout_s
         self.name = name
         self._clock = clock
         self._sampler = sampler
@@ -152,6 +154,7 @@ class FailoverCoordinator:
         self.new_shipper: Optional[SegmentShipper] = None
         self.promotions = 0
         self.drained_bytes = 0
+        self.partitions_detected = 0
         self._metric_names: List[str] = []
 
     # -- detection ---------------------------------------------------------
@@ -170,12 +173,26 @@ class FailoverCoordinator:
         fe = self.handle
         if fe is not None:
             fe = getattr(fe, "frontend", fe)
+        committer_dead = (wal is not None
+                          and wal.committer_error is not None)
+        # every wire-attached follower unreachable while the committer
+        # still runs: the leader is cut off from its replicas — a
+        # partition, not a death (step() labels it "leader_partitioned")
+        conn_states = []
+        if self.shipper is not None:
+            with self.shipper._lock:
+                states = list(self.shipper._followers.values())
+            conn_states = [getattr(st.follower, "conn_state", None)
+                           for st in states]
+            conn_states = [s for s in conn_states if s is not None]
         return {
-            "committer_dead": (wal is not None
-                               and wal.committer_error is not None),
+            "committer_dead": committer_dead,
             "pump_failed": (fe is not None
                             and getattr(fe, "_state", None) == "failed"),
             "beat": wal.last_lsn() if wal is not None else None,
+            "partitioned": (bool(conn_states) and not committer_dead
+                            and all(s == "unreachable"
+                                    for s in conn_states)),
         }
 
     def step(self, now: Optional[float] = None) -> List[Dict]:
@@ -203,15 +220,29 @@ class FailoverCoordinator:
                     or sample.get("pump_failed"))
         reason = ("committer_dead" if sample.get("committer_dead")
                   else "pump_failed")
+        if not dead and sample.get("partitioned"):
+            # the sampler can see the leader process alive but its
+            # links dark (e.g. every shipping client unreachable):
+            # "leader partitioned", not "leader dead". Same debounced
+            # promotion — the epoch fence, not the drain, is what
+            # protects the timeline from the isolated ex-leader.
+            dead, reason = True, "leader_partitioned"
         if (not dead and self.heartbeat_timeout_s is not None
                 and self.heartbeat_age_s > self.heartbeat_timeout_s):
-            dead, reason = True, "heartbeat_timeout"
+            # beats stopped arriving: with positive evidence that the
+            # committer still runs, that is a partition; without it we
+            # can only call the stall itself
+            dead = True
+            reason = ("leader_partitioned" if sample.get("committer_alive")
+                      else "heartbeat_timeout")
         if not dead:
             self._dead_streak = 0  # one healthy sample resets the streak
             return actions
         self._dead_streak += 1
         if self._dead_streak < self.confirm_intervals:
             return actions
+        if reason == "leader_partitioned":
+            self.partitions_detected += 1
         actions.extend(self.promote_now(now, reason=reason))
         return actions
 
@@ -234,12 +265,24 @@ class FailoverCoordinator:
         if self.shipper is not None:
             old_wal = self.shipper.wal
             old_had_thread = self.shipper._thread is not None
+            # PATIENT drain: a remote follower mid-reconnect-backoff
+            # reports zero progress for whole passes without being
+            # done, so "no bytes moved" alone must not end the drain —
+            # only "everyone reached the watermark" (fully_shipped) or
+            # the deadline may. The deadline is real time on purpose:
+            # it bounds waiting on real links, and fake-clock tests
+            # stub the shipper out entirely.
+            deadline = time.monotonic() + max(0.0, self.drain_timeout_s)
             try:
                 while True:
                     got = self.shipper.pump_once()
-                    if not got:
-                        break
                     drained += got
+                    if got:
+                        continue
+                    if self.shipper.fully_shipped() \
+                            or time.monotonic() >= deadline:
+                        break
+                    time.sleep(0.005)
             except Exception:  # noqa: BLE001 - a dead leader's disk may
                 pass           # be gone too; promote from what shipped
             self.shipper.stop()
@@ -331,6 +374,8 @@ class FailoverCoordinator:
 
         reg.gauge("failover.epoch", lambda: self._epoch)
         reg.gauge("failover.promotions_total", lambda: self.promotions)
+        reg.gauge("failover.partitions_detected",
+                  lambda: self.partitions_detected)
         reg.gauge("leader.heartbeat_age_s", lambda: self.heartbeat_age_s)
         reg.gauge("fence.rejected_appends", _rejected_appends)
         reg.gauge("fence.rejected_shipments",

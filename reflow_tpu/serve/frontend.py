@@ -1082,6 +1082,16 @@ class IngestFrontend:
             self.pump_error = None
             self._executing = False
             self.revives += 1
+            if self._thread is not None and not self._thread.is_alive():
+                # the pump thread died WITH the crash (its own window
+                # hit the dead committer) rather than surviving it (the
+                # committer thread failing tickets via when_durable):
+                # re-arm the loop itself, not just the state flag, or
+                # nothing drains the queues and flush() never returns
+                self._thread = threading.Thread(
+                    target=self._pump_loop, name="reflow-ingest-pump",
+                    daemon=True)
+                self._thread.start()
             self._not_full.notify_all()
             self._work.notify_all()
             self._idle.notify_all()

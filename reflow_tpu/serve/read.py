@@ -31,6 +31,7 @@ import itertools
 import threading
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
+from reflow_tpu.net.framing import TransportError
 from reflow_tpu.obs.registry import REGISTRY
 from reflow_tpu.utils.runtime import named_lock
 
@@ -119,6 +120,15 @@ class ReadTier:
         self.replica_reads = 0
         self.leader_fallbacks = 0
         self.stale_reads = 0
+        #: replicas pulled from rotation because their link went
+        #: unreachable or a read blew up link-side; every _route pass
+        #: probes them for restore
+        self._ejected: List[object] = []
+        #: id(replica) -> link object exposing ``conn_state`` (normally
+        #: the shipper's RemoteFollower for the same endpoint)
+        self._links: Dict[int, object] = {}
+        self.ejects = 0
+        self.restores = 0
         self._metric_names: List[str] = []
 
     # -- membership --------------------------------------------------------
@@ -130,11 +140,58 @@ class ReadTier:
     def remove_replica(self, replica) -> None:
         with self._lock:
             self._replicas = [r for r in self._replicas if r is not replica]
+            self._ejected = [r for r in self._ejected if r is not replica]
+            self._links.pop(id(replica), None)
 
     @property
     def replicas(self) -> List[object]:
         with self._lock:
             return list(self._replicas)
+
+    @property
+    def ejected_replicas(self) -> List[object]:
+        with self._lock:
+            return list(self._ejected)
+
+    def bind_link(self, replica, link) -> None:
+        """Tie ``replica``'s rotation eligibility to ``link`` (anything
+        exposing ``conn_state``, normally the
+        :class:`~reflow_tpu.net.client.RemoteFollower` shipping to the
+        same endpoint): while the link reports ``unreachable`` the
+        replica is ejected from rotation, and it is restored on the
+        first probe after recovery."""
+        with self._lock:
+            self._links[id(replica)] = link
+
+    def _link_unreachable(self, replica) -> bool:
+        link = self._links.get(id(replica))
+        return link is not None \
+            and getattr(link, "conn_state", "local") == "unreachable"
+
+    def _eject(self, replica) -> None:
+        with self._lock:
+            if any(r is replica for r in self._ejected):
+                return
+            self._replicas = [r for r in self._replicas
+                              if r is not replica]
+            self._ejected.append(replica)
+            self.ejects += 1
+
+    def _probe_ejected(self) -> None:
+        """Restore any ejected replica whose link recovered. Cheap (an
+        attribute read per ejected replica), so every routed read runs
+        it — recovery latency is one read, not a timer."""
+        with self._lock:
+            if not self._ejected:
+                return
+            back = [r for r in self._ejected
+                    if not self._link_unreachable(r)]
+            if not back:
+                return
+            self._ejected = [r for r in self._ejected
+                             if not any(r is b for b in back)]
+            self._replicas.extend(back)
+            self.restores += len(back)
 
     def promote(self, replica, *, epoch: Optional[int] = None,
                 **durable_kw):
@@ -158,14 +215,26 @@ class ReadTier:
                min_horizon: int, kwargs: Optional[dict] = None,
                ) -> ReadResult:
         kwargs = kwargs or {}
+        self._probe_ejected()
         replicas = self.replicas
         start = next(self._rr)
         n = len(replicas)
         for i in range(n):
             r = replicas[(start + i) % n]
-            if r.published_horizon() < min_horizon:
+            if self._link_unreachable(r):
+                self._eject(r)
                 continue
-            h, value = getattr(r, op)(sink, *args, **kwargs)
+            try:
+                if r.published_horizon() < min_horizon:
+                    continue
+                h, value = getattr(r, op)(sink, *args, **kwargs)
+            except (TransportError, ConnectionError, TimeoutError,
+                    OSError) as e:
+                # link-flavored failure mid-read: out of rotation until
+                # a probe sees the link healthy again
+                self._eject(r)
+                del e
+                continue
             if h < min_horizon:
                 # the snapshot raced an advancing horizon; this replica
                 # is eligible, but this *result* is not — try the next
@@ -219,6 +288,10 @@ class ReadTier:
                   lambda: self.leader_fallbacks)
         reg.gauge(f"{base}.stale_reads", lambda: self.stale_reads)
         reg.gauge(f"{base}.replicas", lambda: len(self.replicas))
+        reg.gauge(f"{base}.ejected_replicas",
+                  lambda: len(self.ejected_replicas))
+        reg.gauge(f"{base}.ejects", lambda: self.ejects)
+        reg.gauge(f"{base}.restores", lambda: self.restores)
         reg.gauge("replica.lag_ticks", self.max_lag_ticks)
         self._metric_names.append(base)
 

@@ -243,6 +243,38 @@ declare("REFLOW_BENCH_FAILOVER_N", "int", 2,
 declare("REFLOW_BENCH_FAILOVER_RUN_S", "float", None,
         "failover bench per-phase write window seconds (default 1.0, "
         "smoke 0.3)")
+declare("REFLOW_BENCH_CHAOS", "flag", False,
+        "bench mode: chaos soak — faulty shipping links + leader kill")
+declare("REFLOW_BENCH_CHAOS_N", "int", 3,
+        "chaos bench follower count")
+declare("REFLOW_BENCH_CHAOS_RUN_S", "float", None,
+        "chaos bench per-phase write window seconds (default 1.2, "
+        "smoke 0.4)")
+
+# -- replication transport (docs/guide.md 'Replication over the wire') ------
+
+declare("REFLOW_NET_IO_TIMEOUT_S", "float", 5.0,
+        "per-operation send/recv/accept timeout on transport "
+        "connections; no blocking wire call may wait longer")
+declare("REFLOW_NET_CONNECT_TIMEOUT_S", "float", 2.0,
+        "TCP connect() deadline when dialing a replica endpoint")
+declare("REFLOW_NET_BACKOFF_BASE_S", "float", 0.05,
+        "first reconnect delay; doubles per consecutive failure")
+declare("REFLOW_NET_BACKOFF_CAP_S", "float", 2.0,
+        "ceiling on the exponential reconnect delay")
+declare("REFLOW_NET_BACKOFF_JITTER", "float", 0.25,
+        "jitter fraction: each delay is scaled by a deterministic "
+        "factor in [1-j, 1+j] from the seeded per-link RNG")
+declare("REFLOW_NET_DEGRADED_AFTER", "int", 1,
+        "consecutive link failures before a follower's connection "
+        "state drops healthy -> degraded")
+declare("REFLOW_NET_UNREACHABLE_AFTER", "int", 4,
+        "consecutive link failures before degraded -> unreachable "
+        "(ReadTier ejects the replica; failover may count a "
+        "partition)")
+declare("REFLOW_NET_FAULT_SEED", "int", 0,
+        "seed for the wire fault-injection schedule (WireFaults); "
+        "same seed = same drops/corruptions/partitions")
 
 
 # -- the config dataclass ---------------------------------------------------

@@ -145,7 +145,8 @@ def iter_frames(payload: bytes, segment: int, base: int,
 class _FollowerState:
     __slots__ = ("name", "follower", "cursor", "applied_horizon",
                  "bytes_total", "shipments", "nacks", "bootstraps",
-                 "fenced")
+                 "fenced", "high_water", "retransmit_bytes",
+                 "link_stalls")
 
     def __init__(self, name: str, follower) -> None:
         self.name = name
@@ -160,6 +161,14 @@ class _FollowerState:
         #: zombie ex-leader's — stop re-offering, the bytes will never
         #: be accepted (retrying would NACK-spin forever)
         self.fenced = False
+        #: furthest position ever offered to this follower: a chunk
+        #: starting below it is a retransmission (NACK resync or
+        #: ack-lost duplicate), counted in ``retransmit_bytes``
+        self.high_water: Optional[LogPosition] = None
+        self.retransmit_bytes = 0
+        #: receive() returned None — link-level no-progress (down,
+        #: mid-backoff, reset mid-exchange); NOT a protocol NACK
+        self.link_stalls = 0
 
 
 class SegmentShipper:
@@ -205,7 +214,13 @@ class SegmentShipper:
         self.crc_stops = 0
         #: NACKs that named a newer epoch — this shipper is fenced
         self.fence_nacks = 0
+        #: bytes re-offered below a follower's high-water mark (the
+        #: WAL-as-retransmit-buffer path, driven by real loss)
+        self.retransmit_bytes = 0
+        #: link-level no-progress passes (follower.receive() -> None)
+        self.link_stalls = 0
         self._metric_names: List[str] = []
+        self._metrics_registry = None
 
     @property
     def epoch(self) -> int:
@@ -232,6 +247,9 @@ class SegmentShipper:
             if name in self._followers:
                 raise ValueError(f"follower {name!r} already attached")
             self._followers[name] = st
+        if self._metrics_registry is not None \
+                and hasattr(follower, "conn_state"):
+            self._publish_conn_state(self._metrics_registry, name)
         return name
 
     def detach(self, name: str) -> None:
@@ -338,6 +356,14 @@ class SegmentShipper:
         nxt = self._next_segment(segs, cur.segment) if seals else None
         shipment = Shipment(cur.segment, cur.offset, payload, chunk_end,
                             seals, nxt, self._leader_tick(), self.epoch)
+        if payload and st.high_water is not None and cur < st.high_water:
+            # re-offering bytes the follower was already sent: the WAL
+            # acting as the retransmit buffer, made visible
+            st.retransmit_bytes += len(payload)
+            self.retransmit_bytes += len(payload)
+        offered = LogPosition(cur.segment, chunk_end)
+        if st.high_water is None or offered > st.high_water:
+            st.high_water = offered
         t0 = time.perf_counter()
         resp = st.follower.receive(shipment)
         if _trace.ENABLED:
@@ -349,6 +375,13 @@ class SegmentShipper:
                              "bytes": len(payload),
                              "seals": seals,
                              "ack": isinstance(resp, ShipAck)})
+        if resp is None:
+            # link-level no-progress (remote follower down or inside a
+            # backoff window): skip this follower for the pass. Not a
+            # NACK — the replica never spoke.
+            st.link_stalls += 1
+            self.link_stalls += 1
+            return False
         if isinstance(resp, ShipAck):
             st.cursor = LogPosition(*resp.cursor)
             st.applied_horizon = resp.horizon
@@ -379,6 +412,20 @@ class SegmentShipper:
 
     # -- backlog / state ---------------------------------------------------
 
+    def fully_shipped(self, horizon: Optional[LogPosition] = None) -> bool:
+        """True when every attached, unfenced follower's cursor has
+        reached ``horizon`` (default: the current synced watermark).
+        The patient-drain predicate: a remote follower mid-backoff
+        reports no progress for whole passes, so a drain loop must ask
+        'is everyone there yet' instead of 'did this pass move bytes'."""
+        if horizon is None:
+            horizon = self._horizon()
+        with self._lock:
+            states = list(self._followers.values())
+        return all(st.fenced or (st.cursor is not None
+                                 and st.cursor >= horizon)
+                   for st in states)
+
     def backlog_segments(self) -> int:
         """How many segments the laggiest follower still has to fetch
         (0 = everyone is inside the watermark segment)."""
@@ -390,17 +437,37 @@ class SegmentShipper:
             return 0
         return max(0, horizon.segment - min(c.segment for c in cursors))
 
+    def _transport_state(self, st: _FollowerState) -> Optional[dict]:
+        """Connection-level state for one follower: the client's
+        reconnect-policy snapshot plus shipper-side retransmit/stall
+        counters. None for in-process followers (no wire, no story)."""
+        snap_fn = getattr(st.follower, "transport_snapshot", None)
+        if snap_fn is None:
+            return None
+        try:
+            snap = dict(snap_fn())
+        except Exception:  # noqa: BLE001 - advisory state only
+            snap = {"state": "unknown"}
+        snap["retransmit_bytes"] = st.retransmit_bytes
+        snap["link_stalls"] = st.link_stalls
+        return snap
+
     def _persist_state(self, horizon: LogPosition) -> None:
         with self._lock:
-            followers = {
-                st.name: {
+            followers = {}
+            transport = {}
+            for st in self._followers.values():
+                followers[st.name] = {
                     "shipped": list(st.cursor) if st.cursor else None,
                     "applied_horizon": st.applied_horizon,
                     "bytes_total": st.bytes_total,
                     "shipments": st.shipments,
                     "nacks": st.nacks,
                     "bootstraps": st.bootstraps,
-                } for st in self._followers.values()}
+                }
+                tsnap = self._transport_state(st)
+                if tsnap is not None:
+                    transport[st.name] = tsnap
         state = {
             "schema": SHIP_STATE_SCHEMA,
             "horizon": list(horizon),
@@ -408,8 +475,12 @@ class SegmentShipper:
             "bytes_total": self.bytes_total,
             "shipments": self.shipments,
             "nacks": self.nacks,
+            "retransmit_bytes": self.retransmit_bytes,
+            "link_stalls": self.link_stalls,
             "followers": followers,
         }
+        if transport:
+            state["transport"] = transport
         path = os.path.join(self.wal_dir, SHIP_STATE_FILE)
         tmp = path + ".tmp"
         try:
@@ -454,11 +525,39 @@ class SegmentShipper:
 
     # -- observability -----------------------------------------------------
 
+    def _net_reconnects_total(self) -> int:
+        with self._lock:
+            states = list(self._followers.values())
+        return sum(getattr(st.follower, "reconnects_total", 0)
+                   for st in states)
+
+    def _conn_state(self, name: str) -> str:
+        with self._lock:
+            st = self._followers.get(name)
+        if st is None:
+            return "detached"
+        return getattr(st.follower, "conn_state", "local")
+
     def publish_metrics(self, registry=None, name: str = "ship") -> None:
         reg = registry if registry is not None else REGISTRY
+        self._metrics_registry = reg
         reg.gauge(f"{name}.bytes_total", lambda: self.bytes_total)
         reg.gauge(f"{name}.backlog_segments", self.backlog_segments)
         reg.gauge(f"{name}.shipments", lambda: self.shipments)
         reg.gauge(f"{name}.nacks", lambda: self.nacks)
         reg.gauge(f"{name}.followers", lambda: len(self._followers))
+        reg.gauge(f"{name}.link_stalls", lambda: self.link_stalls)
+        reg.gauge("net.reconnects_total", self._net_reconnects_total)
+        reg.gauge("net.retransmit_bytes", lambda: self.retransmit_bytes)
         self._metric_names.append(name)
+        self._metric_names.append("net.")
+        with self._lock:
+            states = list(self._followers.values())
+        for st in states:
+            if hasattr(st.follower, "conn_state"):
+                self._publish_conn_state(reg, st.name)
+
+    def _publish_conn_state(self, reg, follower_name: str) -> None:
+        gname = f"replica.{follower_name}.conn_state"
+        reg.gauge(gname, lambda n=follower_name: self._conn_state(n))
+        self._metric_names.append(gname)

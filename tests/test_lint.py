@@ -303,6 +303,53 @@ def test_waiver_with_reason_suppresses_and_counts(tmp_path):
     assert rep["waived"] == 1
 
 
+# -- socket rules -----------------------------------------------------------
+
+def test_socket_naked_recv_and_connect_trip(tmp_path):
+    rep = _lint(tmp_path / "a", {"reflow_tpu/m.py": (
+        "import socket\n"
+        "def pull(sock):\n"
+        "    return sock.recv(4096)\n"
+        "def dial(addr):\n"
+        "    s = socket.socket()\n"
+        "    s.connect(addr)\n"
+        "    return s\n")}, passes=["sockets"])
+    assert _rules(rep) == ["socket-no-timeout"]
+    assert len(rep["findings"]) == 2
+
+
+def test_socket_deadline_in_function_is_clean(tmp_path):
+    rep = _lint(tmp_path / "a", {"reflow_tpu/m.py": (
+        "import socket\n"
+        "def pull(sock, deadline_s):\n"
+        "    sock.settimeout(deadline_s)\n"
+        "    return sock.recv(4096)\n"
+        "def dial(addr):\n"
+        "    return socket.create_connection(addr, timeout=2.0)\n"
+        "def dial_kw(conn, addr):\n"
+        "    conn.connect(addr, timeout=2.0)\n")}, passes=["sockets"])
+    assert rep["findings"] == []
+
+
+def test_socket_rule_scoped_to_socket_importers(tmp_path):
+    # a scheduler's .connect() / .accept() must not trip the rule
+    rep = _lint(tmp_path / "a", {"reflow_tpu/m.py": (
+        "def wire(graph, a, b):\n"
+        "    graph.connect(a, b)\n"
+        "    return graph.accept()\n")}, passes=["sockets"])
+    assert rep["findings"] == []
+
+
+def test_socket_waiver_suppresses_with_reason(tmp_path):
+    rep = _lint(tmp_path / "a", {"reflow_tpu/m.py": (
+        "import socket\n"
+        "def wait_forever(sock):\n"
+        "    # reflow-lint: waive socket-no-timeout -- fixture blocks\n"
+        "    return sock.recv(1)\n")}, passes=["sockets"])
+    assert rep["findings"] == []
+    assert rep["waived"] == 1
+
+
 def test_report_schema_shape(tmp_path):
     rep = _lint(tmp_path / "a", {"reflow_tpu/m.py": "x = 1\n"})
     assert rep["schema"] == "reflow.lint/1"

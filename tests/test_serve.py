@@ -402,6 +402,25 @@ def test_pump_crash_fails_tickets_and_closes_frontend():
     fe.close()                      # still clean to close
 
 
+def test_revive_restarts_a_dead_pump_thread():
+    """When the pump THREAD died with the crash (vs only the state
+    flag flipping from another thread), revive() must re-arm the loop
+    itself — otherwise nothing drains the queues and flush() waits
+    forever (the chaos-bench failover hang)."""
+    crash = CrashInjector(1, only="pump_before_tick")
+    fe, sched, src, sink = make_frontend(crash=crash)
+    with pytest.raises(PumpCrashed):
+        fe.submit(src, lines_batch("a")).result(timeout=5)
+    fe._thread.join(timeout=5)
+    assert not fe._thread.is_alive()     # the loop really exited
+    fe.revive()
+    assert fe._thread.is_alive()         # ...and revive re-armed it
+    assert fe.submit(src, lines_batch("z")).result(timeout=5).applied
+    fe.flush(timeout=5)                  # regression: hung forever
+    assert dict(sched.view(sink.name)).get(("z", 1.0)) == 1
+    fe.close()
+
+
 def test_producer_submit_crash_dies_in_submitting_thread():
     """producer_submit is a PRODUCER-thread seam: the kill surfaces out
     of submit() itself, before any frontend state mutates — the pump

@@ -282,4 +282,35 @@ print(f"TIER1 failover smoke: {r['winner']} promoted to epoch "
       f"{r['acked_batches']} acked batches, zero loss, parity exact")
 EOF
 fi
+
+# optional (RUN_BENCH=1): the chaos smoke — WAL shipping over real TCP
+# links through the seeded fault injector (drop/dup/reorder/corrupt/
+# delay + a scripted one-way partition and connection reset), then
+# quiesce and a leader kill: zero acked-write loss, exact view parity
+# at equal horizons, lag <= one commit window after faults stop, and
+# every post-fence shipment from the ex-leader NACKed, never ACKed.
+if [ "${RUN_BENCH:-0}" = "1" ] && [ $rc -eq 0 ]; then
+  REFLOW_BENCH_CHAOS=1 REFLOW_BENCH_SMOKE=1 JAX_PLATFORMS=cpu \
+    timeout -k 10 300 python bench.py --json-out /tmp/_t1_chaos.json \
+    > /dev/null || rc=3
+  python - <<'EOF' || rc=3
+import json
+r = json.load(open("/tmp/_t1_chaos.json"))
+assert r["acked_loss_max_abs_diff"] == 0, r
+assert r["parity_max_abs_diff"] == 0, r
+assert r["promotion_parity_max_abs_diff"] == 0, r
+assert r["lag_after_quiesce_ticks"] <= r["window_ticks"], r
+assert r["ex_leader_fence_nacks"] >= 1, r
+assert r["ex_leader_post_fence_acks"] == 0, r
+assert r["reconnects_total"] >= 1, r
+assert r["retransmit_bytes"] > 0, r
+print(f"TIER1 chaos smoke: {r['acked_batches']} acked batches, zero "
+      f"loss, parity exact at equal horizons; converged "
+      f"{r['converge_s']}s after quiesce (lag "
+      f"{r['lag_after_quiesce_ticks']} <= {r['window_ticks']}); "
+      f"{r['reconnects_total']} reconnect(s), "
+      f"{r['retransmit_bytes']} retransmit byte(s); ex-leader fenced "
+      f"({r['ex_leader_fence_nacks']} NACK(s), 0 ACKs)")
+EOF
+fi
 exit $rc

@@ -109,6 +109,14 @@ def inspect(path: str) -> dict:
     # epoch turned away — the promotion timeline, span by span
     failover_events: list = []
     fence_rejects: dict = defaultdict(int)
+    # wire transport (net/client.py): net_send per roundtrip on the
+    # net/<follower> track, net_reconnect per recovery attempt — the
+    # per-link health breakdown
+    net_by_link: dict = defaultdict(
+        lambda: {"sends": 0, "send_failures": 0, "send_ms": 0.0,
+                 "ops": defaultdict(int), "reconnect_attempts": 0,
+                 "reconnects": 0, "reconnect_ms": 0.0,
+                 "last_state": None})
     for ev in events:
         if ev.get("ph") == "X":
             by_name[ev.get("name", "?")].append(float(ev.get("dur", 0.0)))
@@ -165,6 +173,26 @@ def inspect(path: str) -> dict:
             if ev.get("name") == "fence_reject":
                 kind = (ev.get("args") or {}).get("kind") or "?"
                 fence_rejects[kind] += 1
+            if ev.get("name") in ("net_send", "net_reconnect"):
+                a = ev.get("args") or {}
+                track = tid_names.get(ev.get("tid"), "net/?")
+                link = track.split("/", 1)[1] if "/" in track else track
+                st = net_by_link[link]
+                if ev["name"] == "net_send":
+                    st["sends"] += 1
+                    st["send_ms"] += float(ev.get("dur", 0.0)) / 1e3
+                    st["ops"][a.get("op") or "?"] += 1
+                    if not a.get("ok", True):
+                        st["send_failures"] += 1
+                else:
+                    st["reconnect_attempts"] += 1
+                    st["reconnect_ms"] += float(ev.get("dur", 0.0)) / 1e3
+                    if a.get("ok") and a.get("recovered"):
+                        st["reconnects"] += 1
+                if a.get("state"):
+                    st["last_state"] = a["state"]
+                elif a.get("ok"):
+                    st["last_state"] = "healthy"
             if ev.get("name") == "wal_fsync":
                 dur = float(ev.get("dur", 0.0))
                 if tid_names.get(ev.get("tid")) == "wal-committer":
@@ -252,6 +280,20 @@ def inspect(path: str) -> dict:
                 (v["lag_ticks"] for v in replay_by_replica.values()),
                 default=0),
         }
+    network = None
+    if net_by_link:
+        network = {}
+        for link, st in sorted(net_by_link.items()):
+            network[link] = {
+                "sends": st["sends"],
+                "send_failures": st["send_failures"],
+                "send_ms": round(st["send_ms"], 3),
+                "ops": dict(sorted(st["ops"].items())),
+                "reconnect_attempts": st["reconnect_attempts"],
+                "reconnects": st["reconnects"],
+                "reconnect_ms": round(st["reconnect_ms"], 3),
+                "last_state": st["last_state"],
+            }
     failover = None
     if failover_events or fence_rejects:
         failover = {
@@ -276,6 +318,7 @@ def inspect(path: str) -> dict:
         "dispatch_by_depth": dispatch_by_depth,
         "per_device": per_device,
         "replication": replication,
+        "network": network,
         "control_actions": control_actions,
         "spans": spans,
         "tickets": len(tickets),
@@ -331,6 +374,16 @@ def _print_human(s: dict) -> None:
             print(f"  ship->{name}: {d['shipments']} shipment(s) "
                   f"{d['bytes']} byte(s) in {d['ship_ms']:.2f}ms, "
                   f"{d['nacks']} nack(s)")
+    net = s.get("network")
+    if net:
+        for link, d in net.items():
+            ops = ", ".join(f"{k}={v}" for k, v in d["ops"].items())
+            print(f"  net/{link}: {d['sends']} send(s) "
+                  f"({d['send_failures']} failed) in "
+                  f"{d['send_ms']:.2f}ms [{ops}]; "
+                  f"{d['reconnects']}/{d['reconnect_attempts']} "
+                  f"reconnect(s) in {d['reconnect_ms']:.2f}ms; "
+                  f"state={d['last_state']}")
     fo = s.get("failover")
     if fo:
         rej = ", ".join(f"{v} {k}(s)"

@@ -187,6 +187,12 @@ def _ship_summary(wal_dir: str, per_seg: dict):
         "bytes_total": state.get("bytes_total"),
         "shipments": state.get("shipments"),
         "nacks": state.get("nacks"),
+        "retransmit_bytes": state.get("retransmit_bytes"),
+        "link_stalls": state.get("link_stalls"),
+        # per-follower wire state (reconnect policy snapshot merged with
+        # shipper-side retransmit/stall counters); absent for logs only
+        # ever shipped to in-process followers
+        "transport": state.get("transport"),
         "followers": {
             name: {"shipped": f.get("shipped"),
                    "applied_horizon": f.get("applied_horizon"),
@@ -249,6 +255,13 @@ def main(argv=None) -> int:
                       f"applied_horizon={f['applied_horizon']} "
                       f"lag_ticks={f['lag_ticks']} "
                       f"bytes={f['bytes_total']} nacks={f['nacks']}")
+        if ship and ship.get("transport"):
+            for fname, t in sorted(ship["transport"].items()):
+                print(f"  transport {fname}: state={t.get('state')} "
+                      f"reconnects={t.get('reconnects')} "
+                      f"retransmit_bytes={t.get('retransmit_bytes')} "
+                      f"link_stalls={t.get('link_stalls')} "
+                      f"last_backoff_s={t.get('last_backoff_s')}")
         ep = summary["epochs"]
         if ep["epoch"] or ep["fenced_by"] is not None:
             status = (f" FENCED by epoch {ep['fenced_by']} — zombie "
