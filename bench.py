@@ -141,6 +141,18 @@ Env knobs::
                                   promotion horizon (CPU-only)
     REFLOW_BENCH_FAILOVER_N       follower count            (default 2)
     REFLOW_BENCH_FAILOVER_RUN_S   per-phase write window (s) (default 1.0)
+    REFLOW_BENCH_COMPACT=1        bounded-history mode instead: two
+                                  identically-fed 16-producer legs
+                                  (unbounded oracle vs checkpoint chain
+                                  + key-level WAL compaction); asserts
+                                  history >= 10x live state, >= 5x
+                                  faster leader crash-recovery AND
+                                  fresh-replica bootstrap vs full-
+                                  history replay, both within 2x of a
+                                  fresh-full-checkpoint restore, exact
+                                  view parity, zero acked-write loss,
+                                  bounded on-disk footprint (CPU-only)
+    REFLOW_BENCH_COMPACT_TICKS    batches per producer (default 480)
     REFLOW_BENCH_CHAOS=1          chaos-soak mode instead: ship the WAL
                                   to N replicas over REAL TCP links, each
                                   wrapped in a seeded fault injector
@@ -1310,6 +1322,316 @@ def run_replica_bench() -> dict:
             ship.close()
         for r in replicas:
             r.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+# -- bounded-history mode (REFLOW_BENCH_COMPACT=1) -------------------------
+
+def run_compact_bench() -> dict:
+    """Bounded history (docs/guide.md "Bounded history"): incremental
+    checkpoint chains + key-level WAL compaction must buy O(state)
+    recovery and fast replica bootstrap without giving up a byte of
+    exactly-once.
+
+    Two identically-fed legs run back to back — 16 producer threads
+    each submit a fixed, deterministic batch stream (every odd batch
+    retracts its predecessor, so live state stays tiny while history
+    grows without bound) through an ``IngestFrontend`` into a durable
+    wordcount leader:
+
+    - **unbounded oracle**: no checkpoints, no compaction — the WAL
+      keeps the full history (the "before" condition);
+    - **bounded**: a ``CheckpointChain`` element every ``save_every``
+      leader ticks (full every ``delta_every``-th save, lag-one WAL
+      truncation) with a ``WalCompactor`` folding the sealed replay
+      tail between saves.
+
+    Then four cold starts are timed:
+
+    1. leader crash-recovery by full-history replay (oracle WAL);
+    2. leader crash-recovery from {chain + compacted tail};
+    3. fresh-replica bootstrap streaming the full oracle WAL;
+    4. fresh-replica bootstrap from {chain + compacted tail};
+
+    plus the floor everything is measured against: restoring a fresh
+    full checkpoint of the final state (the O(state) lower bound).
+
+    Acceptance: WAL history >= 10x live-state bytes; (2) and (4) each
+    >= 5x faster than their full-history twin AND within 2x (+ a fixed
+    50ms epsilon for fsync/transport constants) of the fresh-full
+    floor; EXACT view parity (max_abs_diff == 0) between every
+    recovered/bootstrapped view and its leg's leader view, and between
+    the two legs' quiesced final views (identical batch multiset ->
+    identical fold); zero acked-write loss; the reclaimable-bytes gauge
+    settles near zero after the final pass (bounded footprint).
+
+    Host-side CPU work; runs on the CPU executor/platform."""
+    import shutil
+    import tempfile
+    import threading
+
+    from reflow_tpu.obs import MetricsRegistry
+    from reflow_tpu.scheduler import DirtyScheduler
+    from reflow_tpu.serve import (CoalesceWindow, IngestFrontend,
+                                  ReplicaScheduler)
+    from reflow_tpu.utils.checkpoint import (CheckpointChain,
+                                             load_checkpoint,
+                                             save_checkpoint)
+    from reflow_tpu.wal import (DurableScheduler, SegmentShipper,
+                                WalCompactor, recover)
+    from reflow_tpu.workloads import wordcount
+
+    smoke = env_flag("REFLOW_BENCH_SMOKE")
+    per_prod = env_int("REFLOW_BENCH_COMPACT_TICKS") \
+        or (160 if smoke else 480)
+    n_producers = 16
+    vocab = 300
+    save_every = 24          # leader ticks between chain elements
+    delta_every = 6          # full checkpoint every 6th element
+    eps_s = 0.05             # fixed epsilon on the within-2x floors
+    out = {"producers": n_producers, "per_producer_batches": per_prod,
+           "vocab": vocab, "save_every": save_every,
+           "delta_every": delta_every}
+
+    def words_for(pid, seq):
+        rng = np.random.default_rng(pid * 100_000 + seq)
+        return " ".join(f"w{int(x)}" for x in rng.integers(0, vocab, 24))
+
+    def batch_for(pid, seq):
+        if seq % 2 == 1:
+            # retract the predecessor: live state stays O(recent),
+            # history keeps both records — the compactor's whole case
+            return wordcount.ingest_lines([words_for(pid, seq - 1)],
+                                          weight=-1)
+        return wordcount.ingest_lines([words_for(pid, seq)])
+
+    def du(path):
+        total = 0
+        for base, _dirs, files in os.walk(path):
+            for f in files:
+                total += os.path.getsize(os.path.join(base, f))
+        return total
+
+    def run_leg(tmp, bounded):
+        wal_dir = os.path.join(tmp, "wal-bounded" if bounded
+                               else "wal-full")
+        root = os.path.join(tmp, "ckpt") if bounded else None
+        g, src, sink = wordcount.build_graph()
+        sched = DurableScheduler(g, wal_dir=wal_dir, fsync="tick",
+                                 committer="thread",
+                                 segment_bytes=1 << 15)
+        fe = IngestFrontend(sched, window=CoalesceWindow(
+            max_rows=65536, max_ticks=4, max_latency_s=0.002))
+        chain = comp = None
+        if bounded:
+            chain = CheckpointChain(root, delta_every=delta_every)
+            comp = WalCompactor(sched.wal, ckpt_dir=root,
+                                min_segments=2, keep_segments=1)
+        acked = [0] * n_producers
+        n_saves = 0
+        last_save = 0
+
+        def produce(pid, lo, hi):
+            n = 0
+            tickets = []
+
+            def resolve():
+                nonlocal n
+                for t in tickets:
+                    if t.result(timeout=120).applied:
+                        n += 1
+                tickets.clear()
+
+            for seq in range(lo, hi):
+                tickets.append(fe.submit(src, batch_for(pid, seq),
+                                         batch_id=f"p{pid}-{seq}"))
+                if len(tickets) >= 64:
+                    resolve()
+            resolve()
+            acked[pid] += n
+
+        def save_and_compact():
+            nonlocal n_saves, last_save
+            fe.pause()
+            try:
+                chain.save(sched)
+            finally:
+                fe.resume()
+            n_saves += 1
+            last_save = sched._tick
+            comp.compact_once()
+
+        def drive(lo, hi):
+            threads = [threading.Thread(target=produce,
+                                        args=(pid, lo, hi))
+                       for pid in range(n_producers)]
+            for t in threads:
+                t.start()
+            while any(t.is_alive() for t in threads):
+                if bounded and sched._tick - last_save >= save_every:
+                    save_and_compact()
+                time.sleep(0.002)
+            for t in threads:
+                t.join()
+
+        # two write phases around a guaranteed chain save: heavy
+        # coalescing can finish a smoke run in fewer leader ticks than
+        # ``save_every``, and the bounded leg MUST exercise {chain +
+        # compacted tail}, not compaction alone — phase 2's records are
+        # the replay tail past the last anchor
+        split = (4 * per_prod) // 5
+        drive(0, split)
+        if bounded:
+            fe.flush()
+            save_and_compact()
+        drive(split, per_prod)
+        fe.flush()
+        sched.wal.sync()
+        if bounded:
+            while comp.compact_once() is not None:
+                pass  # drain: fold the sealed tail completely
+        view = {kv: w for kv, w in sched.view(sink.name).items()
+                if w != 0}
+        tick = sched._tick
+        fe.close()
+        sched.close()
+        return {"wal_dir": wal_dir, "root": root, "view": view,
+                "tick": tick, "acked": sum(acked), "chain": chain,
+                "comp": comp, "sink": sink.name, "saves": n_saves}
+
+    def diff(a, b):
+        return max((abs(a.get(kv, 0) - b.get(kv, 0))
+                    for kv in set(a) | set(b)), default=0)
+
+    def timed_recover(wal_dir, root):
+        g, _s, sink = wordcount.build_graph()
+        sched = DirtyScheduler(g)
+        t0 = time.perf_counter()
+        recover(sched, wal_dir, root)
+        dt = time.perf_counter() - t0
+        view = {kv: w for kv, w in sched.view(sink.name).items()
+                if w != 0}
+        return dt, view, sched._tick, sched
+
+    def timed_bootstrap(tmp, wal_dir, root, target_tick, name):
+        ship = SegmentShipper(wal_dir=wal_dir, ckpt_dir=root)
+        g, _s, sink = wordcount.build_graph()
+        r = ReplicaScheduler(g, os.path.join(tmp, name), name=name)
+        t0 = time.perf_counter()
+        ship.attach(r)
+        stalls = 0
+        while r.published_horizon() < target_tick:
+            if ship.pump_once() == 0:
+                stalls += 1
+                if stalls > 3:
+                    break
+            else:
+                stalls = 0
+        dt = time.perf_counter() - t0
+        assert r.published_horizon() == target_tick, \
+            (name, r.published_horizon(), target_tick)
+        _h, view = r.view_at(sink)
+        ship.close()
+        r.close()
+        return dt, view
+
+    tmp = tempfile.mkdtemp(prefix="reflow-compact-")
+    try:
+        full = run_leg(tmp, bounded=False)
+        bounded = run_leg(tmp, bounded=True)
+        assert full["acked"] == bounded["acked"] \
+            == n_producers * per_prod, "acked-write loss at submit time"
+        out["acked_batches"] = bounded["acked"]
+        # identical batch multiset -> identical final fold, exactly
+        out["legs_parity_max_abs_diff"] = diff(full["view"],
+                                               bounded["view"])
+        assert out["legs_parity_max_abs_diff"] == 0
+
+        comp = bounded["comp"]
+        reg = MetricsRegistry()
+        comp.publish_metrics(reg)
+        full_bytes = du(full["wal_dir"])
+        bounded_bytes = du(bounded["wal_dir"]) + du(bounded["root"])
+        out["wal_full_bytes"] = full_bytes
+        out["wal_bounded_bytes"] = du(bounded["wal_dir"])
+        out["ckpt_chain_bytes"] = du(bounded["root"])
+        out["chain_saves"] = bounded["saves"]
+        out["leader_ticks"] = bounded["tick"]
+        assert bounded["saves"] >= 1 and out["ckpt_chain_bytes"] > 0, \
+            "bounded leg never cut a checkpoint chain element"
+        out["compact_folds"] = comp.folds
+        out["compact_reclaimed_bytes"] = comp.reclaimed_bytes
+        out["reclaimable_bytes_final"] = reg.value(
+            "compact.reclaimable_bytes", comp.reclaimable_bytes())
+
+        # -- leader crash-recovery ------------------------------------
+        t_full, v_full, tick_full, _ = timed_recover(
+            full["wal_dir"], None)
+        assert tick_full == full["tick"]
+        assert diff(v_full, full["view"]) == 0
+        t_bounded, v_bounded, tick_b, sched_b = timed_recover(
+            bounded["wal_dir"], bounded["root"])
+        assert tick_b == bounded["tick"]
+        assert diff(v_bounded, bounded["view"]) == 0
+        log(f"compact[recover]: full replay {t_full:.3f}s vs "
+            f"chain+tail {t_bounded:.3f}s")
+
+        # -- the O(state) floor: a fresh full checkpoint --------------
+        fresh_dir = os.path.join(tmp, "fresh-full")
+        save_checkpoint(sched_b, fresh_dir)
+        g2, _s2, _k2 = wordcount.build_graph()
+        t0 = time.perf_counter()
+        load_checkpoint(DirtyScheduler(g2), fresh_dir)
+        t_fresh = time.perf_counter() - t0
+        state_bytes = du(fresh_dir)
+        out["state_bytes"] = state_bytes
+        out["history_ratio"] = round(full_bytes / max(1, state_bytes), 2)
+
+        # -- fresh-replica bootstrap ----------------------------------
+        tb_full, rv_full = timed_bootstrap(
+            tmp, full["wal_dir"], None, full["tick"], "boot-full")
+        assert diff(rv_full, full["view"]) == 0
+        tb_bounded, rv_bounded = timed_bootstrap(
+            tmp, bounded["wal_dir"], bounded["root"], bounded["tick"],
+            "boot-bounded")
+        assert diff(rv_bounded, bounded["view"]) == 0
+        log(f"compact[bootstrap]: full stream {tb_full:.3f}s vs "
+            f"chain+tail {tb_bounded:.3f}s (fresh-full floor "
+            f"{t_fresh:.3f}s)")
+
+        out["recover_full_s"] = round(t_full, 4)
+        out["recover_bounded_s"] = round(t_bounded, 4)
+        out["bootstrap_full_s"] = round(tb_full, 4)
+        out["bootstrap_bounded_s"] = round(tb_bounded, 4)
+        out["fresh_full_restore_s"] = round(t_fresh, 4)
+        out["recover_speedup_x"] = round(t_full / max(t_bounded, 1e-9), 2)
+        out["bootstrap_speedup_x"] = round(
+            tb_full / max(tb_bounded, 1e-9), 2)
+        out["parity_max_abs_diff"] = max(
+            out["legs_parity_max_abs_diff"], diff(v_full, full["view"]),
+            diff(v_bounded, bounded["view"]),
+            diff(rv_full, full["view"]),
+            diff(rv_bounded, bounded["view"]))
+        out["history_ratio_ok"] = out["history_ratio"] >= 10
+        out["recover_speedup_ok"] = out["recover_speedup_x"] >= 5
+        out["bootstrap_speedup_ok"] = out["bootstrap_speedup_x"] >= 5
+        out["recover_near_floor_ok"] = \
+            t_bounded <= 2 * t_fresh + eps_s
+        out["bootstrap_near_floor_ok"] = \
+            tb_bounded <= 2 * t_fresh + eps_s
+        out["footprint_bounded_ok"] = bounded_bytes * 3 <= full_bytes
+        out["zero_acked_loss"] = (out["parity_max_abs_diff"] == 0
+                                  and out["acked_batches"]
+                                  == n_producers * per_prod)
+        log(f"compact[summary]: history {out['history_ratio']}x state, "
+            f"recover {out['recover_speedup_x']}x, bootstrap "
+            f"{out['bootstrap_speedup_x']}x, footprint "
+            f"{bounded_bytes}/{full_bytes} bytes, "
+            f"{comp.folds} fold(s), reclaimed "
+            f"{comp.reclaimed_bytes} bytes")
+        comp.close()
+    finally:
         shutil.rmtree(tmp, ignore_errors=True)
     return out
 
@@ -2970,6 +3292,18 @@ def main() -> None:
         _emit({
             "metric": "replica_read_scaling_x",
             "value": out["read_scaling_x"],
+            "unit": "x",
+            **out,
+        }, json_out)
+        return
+
+    if env_flag("REFLOW_BENCH_COMPACT"):
+        # bounded-history mode is host-side CPU work — no tunnel
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        out = run_compact_bench()
+        _emit({
+            "metric": "compact_recover_speedup_x",
+            "value": out["recover_speedup_x"],
             "unit": "x",
             **out,
         }, json_out)

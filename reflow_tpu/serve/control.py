@@ -419,6 +419,10 @@ class ControlConfig:
     #: dead-WAL-committer respawn budget per graph; exhausted = the
     #: graph fails fast instead of looping (respawn-or-fail-fast)
     max_committer_restarts: int = 3
+    #: dead-WAL-compactor respawn budget (same respawn-or-fail-fast
+    #: stance: a compactor that keeps dying has hit real corruption,
+    #: and the log is merely unbounded without it, never wrong)
+    max_compactor_restarts: int = 3
     # -- elasticity --
     #: pump-pool autoscale range; None disables autoscaling
     min_workers: Optional[int] = None
@@ -483,13 +487,19 @@ class ControlPlane:
                  clock: Callable[[], float] = time.monotonic,
                  rng: Optional[Callable[[], float]] = None,
                  sampler: Optional[Callable[[float], Dict]] = None,
-                 failover=None):
+                 failover=None, compactor=None):
         from reflow_tpu.obs import REGISTRY
         self.tier = tier
         #: optional serve.failover.FailoverCoordinator, stepped on the
         #: control interval — leader-death detection and promotion ride
         #: the same supervision loop as the other actuators
         self.failover = failover
+        #: optional wal.compact.WalCompactor, supervised on the control
+        #: interval with the committer's respawn-or-fail-fast budget
+        self.compactor = compactor
+        self._compactor_restarts_used = 0
+        self._compactor_failed = False
+        self._compactor_booted = False
         # file first, explicit specs= override per graph — an operator
         # config sets the fleet default, code pins the exceptions
         self.specs = (dict(load_slo_specs(config_path))
@@ -524,7 +534,8 @@ class ControlPlane:
             "brownout_steps", "respawns", "breaker_opens",
             "breaker_probes", "breaker_closes", "worker_respawns",
             "committer_restarts", "scale_ups", "scale_downs",
-            "reclaims", "floor_restores", "errors")}
+            "reclaims", "floor_restores", "errors",
+            "compactions", "compactor_restarts")}
         reg.gauge("pool.live_workers", lambda: self.tier.live_workers)
         reg.gauge("control.interval_s", lambda: self.config.interval_s)
 
@@ -610,9 +621,45 @@ class ControlPlane:
         self._step_pool(now, sample, actions)
         if self.failover is not None:
             actions.extend(self.failover.step(now))
+        if self.compactor is not None:
+            self._step_compactor(now, actions)
         for a in actions:
             self._record(a)
         return actions
+
+    def _step_compactor(self, now: float, actions: List[Dict]) -> None:
+        """Supervise the background WAL compactor: surface completed
+        passes as actions, respawn a dead thread within the budget,
+        fail fast past it (unbounded log, loudly — not a wrong one)."""
+        comp = self.compactor
+        for ev in comp.drain_events():
+            self._c["compactions"].inc()
+            actions.append({"now": now, "kind": "wal_compact",
+                            "out": ev["out"], "covers": ev["covers"],
+                            "segments": ev["segments"],
+                            "reclaimed_bytes": ev["reclaimed_bytes"],
+                            "gen": ev["gen"]})
+        if comp.alive or self._compactor_failed:
+            return
+        if not self._compactor_booted:
+            # first sight of a cold compactor: the control plane owns
+            # its lifecycle — boot it for free, budget only respawns
+            comp.start()
+            self._compactor_booted = True
+            return
+        cfg = self.config
+        if self._compactor_restarts_used >= cfg.max_compactor_restarts:
+            self._compactor_failed = True
+            actions.append({"now": now, "kind": "compactor_failed",
+                            "error": repr(comp.last_error),
+                            "used": self._compactor_restarts_used})
+            return
+        if comp.restart():
+            self._compactor_restarts_used += 1
+            self._c["compactor_restarts"].inc()
+            actions.append({"now": now, "kind": "compactor_restart",
+                            "used": self._compactor_restarts_used,
+                            "error": repr(comp.last_error)})
 
     def _spec_for(self, h) -> Optional[SLOSpec]:
         spec = self.specs.get(h.name, self.config.default_slo)

@@ -322,10 +322,11 @@ class ReplicaScheduler:
         """Restart-resume: local checkpoint (if any) + mirrored tail.
         The cursor comes out at the end of the mirror's valid prefix —
         never segment 0 unless the replica truly is fresh."""
-        from reflow_tpu.utils.checkpoint import load_checkpoint
+        from reflow_tpu.utils.checkpoint import (checkpoint_exists,
+                                                 load_checkpoint)
 
         start: Optional[Tuple[int, int]] = None
-        if os.path.exists(os.path.join(self.ckpt_dir, "meta.pkl")):
+        if checkpoint_exists(self.ckpt_dir):
             meta = load_checkpoint(self.sched, self.ckpt_dir)
             start = meta.get("wal_pos")
             self._horizon = self.sched._tick

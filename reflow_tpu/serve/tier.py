@@ -225,6 +225,8 @@ class ServeTier:
         # -- counters (utils.metrics.summarize_tier) --
         self.windows = 0
         self.pool_crashes = 0
+        #: completed checkpoint_barrier() cuts (the barrier seq)
+        self.barriers = 0
         #: picks whose graph's device already had a window in flight —
         #: the placement-aware DWRR tie-break could not avoid the
         #: contention (every positive-deficit candidate was co-located
@@ -328,6 +330,54 @@ class ServeTier:
         siblings keep ticking throughout. Returns the drain tick
         count."""
         return self.handle(name).drain(source, **kw)
+
+    def checkpoint_barrier(self, saver, *, names: Optional[List[str]]
+                           = None) -> Dict[str, object]:
+        """Tier-wide checkpoint barrier: one consistent cut across all
+        (or ``names``) graphs. Every frontend is paused — each quiesces
+        at a macro-tick boundary, so each graph's cut is a whole-window
+        horizon — and only once ALL of them are idle does
+        ``saver(name, handle)`` run per graph against the frozen
+        schedulers (a ``CheckpointChain.save``, a ``save_checkpoint``,
+        a state probe — the tier does not care). Admission keeps
+        queueing throughout; producers block at the budget, they are
+        not failed. Resumes everything even when a saver raises.
+
+        Returns ``{"barrier": seq, "horizons": {name: tick},
+        "results": {name: saver result}}`` — the horizons are the
+        per-graph macro-tick cut the chain manifests record, which is
+        what makes cross-tenant restore consistent: every graph's
+        checkpoint in one barrier observes a single quiesced tier."""
+        with self._lock:
+            if self._closed:
+                raise GraphError("tier is closed; barrier refused")
+            if names is None:
+                handles = dict(self._graphs)
+            else:
+                handles = {n: self._graphs[n] for n in names}
+            self.barriers += 1
+            seq = self.barriers
+        paused: List[GraphHandle] = []
+        results: Dict[str, object] = {}
+        t0 = time.perf_counter()
+        try:
+            for h in handles.values():
+                h.frontend.pause()
+                paused.append(h)
+            horizons = {n: h.frontend.sched._tick
+                        for n, h in handles.items()}
+            for n, h in handles.items():
+                results[n] = saver(n, h)
+        finally:
+            for h in paused:
+                h.frontend.resume()
+        if _trace.ENABLED:
+            _trace.evt("checkpoint_barrier", t0,
+                       time.perf_counter() - t0,
+                       args={"barrier": seq,
+                             "graphs": sorted(handles)})
+        return {"barrier": seq, "horizons": horizons,
+                "results": results}
 
     def unregister(self, name: str, *, flush: bool = True,
                    timeout: Optional[float] = None) -> GraphHandle:
@@ -568,13 +618,15 @@ class ServeTier:
                 settle_h.frontend._settle_all()
             except BaseException as e:  # noqa: BLE001 - fault isolation
                 crashed = True
+                # count the crash BEFORE tickets fail: an observer who
+                # caught a PumpCrashed result must already see it
+                with self._lock:
+                    self.pool_crashes += 1
+                    settle_h.crashes += 1
                 settle_h.frontend._on_pump_crash(e)
             with self._lock:
                 self._busy_s += time.perf_counter() - t0
-                if crashed:
-                    self.pool_crashes += 1
-                    settle_h.crashes += 1
-                else:
+                if not crashed:
                     settle_h.frontend._finish_window()
                 self._work.notify_all()
             return True
@@ -588,7 +640,14 @@ class ServeTier:
             picked.frontend._run_window(drained)
         except BaseException as e:  # noqa: BLE001 - fault isolation
             crashed = True
+            # count the crash BEFORE tickets fail: an observer who
+            # caught a PumpCrashed result must already see it
+            with self._lock:
+                self.pool_crashes += 1
+                picked.crashes += 1
             picked.frontend._on_pump_crash(e, window=drained)
+            # _on_pump_crash released the latch, the graph's bytes,
+            # and its blocked producers
         busy = time.perf_counter() - t0
         rows = sum(e.rows for entries in drained.values()
                    for e in entries)
@@ -597,12 +656,7 @@ class ServeTier:
             self.windows += 1
             picked.windows += 1
             picked._deficit -= max(rows, 1)
-            if crashed:
-                self.pool_crashes += 1
-                picked.crashes += 1
-                # _on_pump_crash already released the latch, the
-                # graph's bytes, and its blocked producers
-            else:
+            if not crashed:
                 picked.rows_applied += rows
                 picked.frontend._finish_window()
             # re-evaluate readiness pool-wide: the just-unlatched

@@ -22,15 +22,67 @@ Two serialization paths behind one API:
 
 Layout: ``<dir>/meta.pkl`` (tick, sink views, host states) and
 ``<dir>/states/`` (orbax tree of the array states, if any).
+
+Bounded history (incremental checkpoints)
+-----------------------------------------
+A full checkpoint is O(state) bytes *every* save, which caps how often
+an operator can afford to take one — and the WAL only truncates at
+saves, so rare saves mean O(history) replay tails. :class:`CheckpointChain`
+fixes the cost side: it manages a directory of one **full** checkpoint
+plus a chain of **delta** elements (per-source state snapshots of only
+what changed since the previous element, keyed by the macro-tick
+horizon), linked by a ``chain.json`` manifest. ``load_checkpoint`` on a
+chain directory restores base + deltas in order; a broken link
+mid-chain fails loud, while a torn/partial *final* delta falls back one
+chain element — exactly the WAL's torn-tail stance. To make that
+fallback always recoverable, WAL truncation lags one element: a delta
+save truncates only up to the *previous* element's anchor, so the log
+still covers the newest element's window if its file is lost.
+
+Delta file framing mirrors the WAL: ``RFCKD001`` magic, then one
+``[u32 len][u32 crc32]`` pickled payload — torn bytes are detected the
+same way a torn WAL record is.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import pickle
-from typing import Dict
+import struct
+import zlib
+from typing import Dict, List, Optional
 
-__all__ = ["save_checkpoint", "load_checkpoint", "meta_digest"]
+__all__ = ["save_checkpoint", "load_checkpoint", "meta_digest",
+           "checkpoint_exists", "CheckpointChain", "CheckpointError",
+           "load_chain", "read_chain_manifest", "chain_head_wal_pos",
+           "CHAIN_MANIFEST", "CHAIN_SCHEMA"]
+
+CHAIN_MANIFEST = "chain.json"
+CHAIN_SCHEMA = "reflow.ckpt_chain/1"
+_DELTA_MAGIC = b"RFCKD001"
+_DELTA_HEADER = struct.Struct("<II")
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint/chain element is unreadable or the chain is
+    inconsistent (broken parent link, horizon mismatch)."""
+
+    def __init__(self, msg: str, *, torn: bool = False):
+        super().__init__(msg)
+        #: True when the element's *bytes* are torn/short/corrupt (the
+        #: WAL-torn-tail analogue) as opposed to a structural link break
+        self.torn = torn
+
+
+def checkpoint_exists(path: Optional[str]) -> bool:
+    """True when ``path`` holds a restorable checkpoint — either a
+    legacy full checkpoint (``meta.pkl``) or a chain directory
+    (``chain.json``)."""
+    if path is None:
+        return False
+    return (os.path.exists(os.path.join(path, CHAIN_MANIFEST))
+            or os.path.exists(os.path.join(path, "meta.pkl")))
 
 
 def _split_states(states: Dict[int, object]):
@@ -57,7 +109,7 @@ def meta_digest(tick: int, seen_batch_ids) -> int:
     return int.from_bytes(h.digest()[:8], "big")
 
 
-def save_checkpoint(sched, path: str) -> None:
+def save_checkpoint(sched, path: str, *, truncate: bool = True) -> None:
     """Multi-controller: every process calls this collectively with the
     same (shared-filesystem) path — orbax writes each process's
     addressable shards of the global arrays; the host-side meta (tick
@@ -120,7 +172,7 @@ def save_checkpoint(sched, path: str) -> None:
         ckpt.save(os.path.join(os.path.abspath(path), "states"), arr,
                   force=True)
         ckpt.wait_until_finished()
-    if wal is not None:
+    if wal is not None and truncate:
         from reflow_tpu.wal.log import LogPosition
 
         wal.truncate_until(LogPosition(*meta["wal_pos"]))
@@ -128,12 +180,26 @@ def save_checkpoint(sched, path: str) -> None:
 
 def load_checkpoint(sched, path: str) -> Dict:
     """Restore into a scheduler whose graph/executor match the saved one.
-    Returns the checkpoint meta dict (``wal.recovery.recover`` reads the
-    recorded WAL replay position, ``"wal_pos"``, from it)."""
+    ``path`` may be a legacy full checkpoint directory (``meta.pkl``) or
+    a :class:`CheckpointChain` directory (``chain.json``) — a chain is
+    restored base-then-deltas. Returns the checkpoint meta dict
+    (``wal.recovery.recover`` reads the recorded WAL replay position,
+    ``"wal_pos"``, from it)."""
+    if os.path.exists(os.path.join(path, CHAIN_MANIFEST)):
+        return load_chain(sched, path)
+    return _load_full(sched, path)
+
+
+def _load_full(sched, path: str) -> Dict:
+    """The legacy single-directory restore (meta.pkl + orbax states)."""
     from collections import Counter
 
-    with open(os.path.join(path, "meta.pkl"), "rb") as f:
-        meta = pickle.load(f)
+    try:
+        with open(os.path.join(path, "meta.pkl"), "rb") as f:
+            meta = pickle.load(f)
+    except (OSError, pickle.UnpicklingError, EOFError) as e:
+        raise CheckpointError(f"{path}: unreadable checkpoint meta "
+                              f"({e})", torn=True) from e
     sched._tick = meta["tick"]
     sched._seen_batch_ids = dict(meta["seen_batch_ids"])
     sched._pending.clear()
@@ -166,3 +232,411 @@ def load_checkpoint(sched, path: str) -> Dict:
     # so the in-program validity predicate alone cannot see the swap.
     sched.executor.on_states_replaced()
     return meta
+
+
+# -- incremental checkpoint chain ------------------------------------------
+
+
+def read_chain_manifest(root: str) -> Optional[dict]:
+    """The chain manifest as a dict, or None when ``root`` is not a
+    chain directory. Raises :class:`CheckpointError` on unparseable
+    JSON (a half-written manifest is a broken chain, not an empty one —
+    the flip is atomic, so this only happens under real corruption)."""
+    path = os.path.join(root, CHAIN_MANIFEST)
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointError(f"{path}: unreadable chain manifest "
+                              f"({e})") from e
+
+
+def chain_head_wal_pos(root: str):
+    """The newest chain element's recorded WAL anchor as a
+    ``(segment, offset)`` tuple, or None (no chain / no WAL)."""
+    m = read_chain_manifest(root)
+    if m is None or m.get("wal_pos") is None:
+        return None
+    return tuple(m["wal_pos"])
+
+
+def _write_delta_file(path: str, payload: dict) -> int:
+    body = pickle.dumps(payload)
+    frame = (_DELTA_MAGIC + _DELTA_HEADER.pack(len(body),
+                                               zlib.crc32(body)) + body)
+    with open(path, "wb") as f:
+        f.write(frame)
+        f.flush()
+        os.fsync(f.fileno())
+    return len(frame)
+
+
+def _read_delta_file(path: str) -> dict:
+    """Parse one framed delta element; raises :class:`CheckpointError`
+    (``torn=True``) on missing/short/CRC-torn bytes — the condition the
+    chain loader answers by falling back one element."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        raise CheckpointError(f"{path}: missing delta element ({e})",
+                              torn=True) from e
+    if data[:len(_DELTA_MAGIC)] != _DELTA_MAGIC:
+        raise CheckpointError(f"{path}: bad delta magic "
+                              f"{data[:len(_DELTA_MAGIC)]!r}", torn=True)
+    off = len(_DELTA_MAGIC)
+    if off + _DELTA_HEADER.size > len(data):
+        raise CheckpointError(f"{path}: truncated delta header",
+                              torn=True)
+    length, crc = _DELTA_HEADER.unpack_from(data, off)
+    body = data[off + _DELTA_HEADER.size: off + _DELTA_HEADER.size
+                + length]
+    if len(body) < length:
+        raise CheckpointError(
+            f"{path}: truncated delta payload ({len(body)}/{length} "
+            f"bytes)", torn=True)
+    if zlib.crc32(body) != crc:
+        raise CheckpointError(f"{path}: delta CRC mismatch", torn=True)
+    try:
+        return pickle.loads(body)
+    except Exception as e:  # noqa: BLE001 - framed+CRC-clean yet unloadable
+        raise CheckpointError(f"{path}: unpicklable delta payload "
+                              f"({e})", torn=True) from e
+
+
+def _numpyify(tree):
+    import jax
+    import numpy as np
+
+    return jax.tree.map(lambda a: np.asarray(a), tree)
+
+
+def _apply_delta(sched, payload: dict) -> None:
+    from collections import Counter
+
+    sched._tick = payload["tick"]
+    for sink, kv in payload["view_deltas"].items():
+        view = sched.sink_views.get(sink)
+        if view is None:
+            view = sched.sink_views[sink] = Counter()
+        for k, v in kv.items():
+            if v is None:
+                view.pop(k, None)
+            else:
+                view[k] = v
+    states = sched.executor.states
+    for nid, blob in payload["host_states"].items():
+        states[nid] = pickle.loads(blob)
+    if payload.get("array_states"):
+        import jax
+
+        for nid, np_tree in payload["array_states"].items():
+            live = states.get(nid)
+            if live is not None and any(
+                    isinstance(leaf, jax.Array)
+                    for leaf in jax.tree.leaves(live)):
+                # restore each leaf directly into the live leaf's
+                # sharding (same stance as the orbax full-restore path)
+                states[nid] = jax.tree.map(
+                    lambda np_v, lv: jax.device_put(
+                        np_v, lv.sharding) if isinstance(lv, jax.Array)
+                    else np_v,
+                    np_tree, live)
+            else:
+                states[nid] = np_tree
+    for b in payload["ids_added"]:
+        sched._seen_batch_ids[b] = None
+    for _ in range(payload["ids_dropped"]):
+        if not sched._seen_batch_ids:
+            break
+        sched._seen_batch_ids.pop(next(iter(sched._seen_batch_ids)))
+    sched._pending.clear()
+    for nid, batches in payload["pending"].items():
+        sched._pending[nid].extend(batches)
+
+
+def load_chain(sched, root: str) -> Dict:
+    """Restore a :class:`CheckpointChain` directory: the base full
+    checkpoint, then every delta element in manifest order. A broken
+    link anywhere mid-chain (missing/corrupt element, parent or horizon
+    mismatch) fails loud; a torn/partial *final* delta falls back to
+    the previous chain element — the WAL still covers its window
+    because truncation lags one element. Returns a meta dict whose
+    ``"wal_pos"`` is the last successfully applied element's anchor."""
+    manifest = read_chain_manifest(root)
+    if manifest is None:
+        raise CheckpointError(f"{root}: no chain manifest")
+    base = manifest["base"]
+    meta = _load_full(sched, os.path.join(root, base))
+    wal_pos = meta.get("wal_pos")
+    prev_name = base
+    applied = 0
+    fallback = None
+    deltas: List[str] = list(manifest.get("deltas", []))
+    for i, dname in enumerate(deltas):
+        try:
+            payload = _read_delta_file(os.path.join(root, dname))
+            if payload.get("parent") != prev_name \
+                    or payload.get("base_tick") != sched._tick:
+                raise CheckpointError(
+                    f"{root}/{dname}: broken chain link (parent "
+                    f"{payload.get('parent')!r} @ tick "
+                    f"{payload.get('base_tick')!r}, expected "
+                    f"{prev_name!r} @ tick {sched._tick})")
+        except CheckpointError as e:
+            if e.torn and i == len(deltas) - 1:
+                # torn tail of the chain: fall back one element, the
+                # WAL tail (truncation lagged one save) replays the gap
+                fallback = str(e)
+                break
+            raise
+        _apply_delta(sched, payload)
+        if payload.get("wal_pos") is not None:
+            wal_pos = tuple(payload["wal_pos"])
+        prev_name = dname
+        applied += 1
+    sched.executor.on_states_replaced()
+    out = {
+        "tick": sched._tick,
+        "wal_pos": wal_pos,
+        "seen_batch_ids": dict(sched._seen_batch_ids),
+        "chain": {"base": base, "deltas_applied": applied,
+                  "deltas_total": len(deltas), "fallback": fallback},
+    }
+    if wal_pos is None:
+        out.pop("wal_pos")
+    return out
+
+
+class CheckpointChain:
+    """Writer side of the bounded-history checkpoint chain.
+
+    ``save(sched)`` takes a cheap **delta** element (only the sinks,
+    per-source states, dedup-window entries and pending buffers that
+    changed since the previous element), promoting to a **full**
+    checkpoint every ``delta_every``-th save (or when forced with
+    ``full=True``; the very first save is always full). Every save
+    follows the WAL choreography of ``save_checkpoint`` — sync, rotate,
+    record the fresh segment start as the element's anchor — and then
+    truncates the log up to the *previous* element's anchor (lag-one:
+    a torn final delta must leave its window replayable from the WAL).
+
+    The atomic commit point of every save is the ``chain.json``
+    manifest flip (write-tmp + fsync + ``os.replace``): a crash before
+    the flip leaves the previous chain fully restorable, a crash after
+    it leaves the new one. ``crash`` is a
+    :class:`~reflow_tpu.utils.faults.CrashInjector` seam hook
+    (``ckpt_full_before_flip`` / ``ckpt_delta_before_flip`` /
+    ``ckpt_delta_after_flip``) for the differential crash tests."""
+
+    def __init__(self, root: str, *, delta_every: Optional[int] = None,
+                 crash=None):
+        from reflow_tpu.utils.config import env_int
+
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.delta_every = (delta_every if delta_every is not None
+                            else env_int("REFLOW_CKPT_DELTA_EVERY"))
+        self._crash = crash
+        self.saves = 0
+        self.fulls = 0
+        self.deltas = 0
+        self.delta_bytes = 0
+        #: what the previous element looked like, for diffing; None
+        #: forces the next save to be full (fresh writer, fresh chain)
+        self._shadow: Optional[dict] = None
+
+    def _crash_point(self, name: str) -> None:
+        if self._crash is not None:
+            self._crash.point(name)
+
+    # -- shadow bookkeeping ------------------------------------------------
+
+    @staticmethod
+    def _classify_states(states: Dict):
+        """(host {nid: pickled bytes}, array {nid: numpy pytree}) —
+        both forms are digestable/diffable host-side."""
+        import jax
+
+        host, arr = {}, {}
+        for nid, st in states.items():
+            leaves = jax.tree.leaves(st) if isinstance(st, dict) else []
+            if leaves and all(isinstance(v, jax.Array) for v in leaves):
+                arr[nid] = _numpyify(st)
+            else:
+                host[nid] = pickle.dumps(st)
+        return host, arr
+
+    def _snapshot(self, sched) -> dict:
+        host, arr = self._classify_states(sched.executor.states)
+        return {
+            "tick": sched._tick,
+            "views": {name: dict(c)
+                      for name, c in sched.sink_views.items()},
+            "host": host,
+            "arr_blobs": {nid: pickle.dumps(t) for nid, t in arr.items()},
+            "arr_trees": arr,
+            "ids": dict(sched._seen_batch_ids),
+        }
+
+    # -- saves -------------------------------------------------------------
+
+    def _wal_anchor(self, sched):
+        """sync+rotate the scheduler's WAL (if any) and return the
+        fresh segment start — the element's replay anchor."""
+        wal = getattr(sched, "wal", None)
+        if wal is None:
+            return None
+        wal.sync()
+        wal.rotate()
+        pos = tuple(wal.position())
+        wal.append({"kind": "ckpt", "tick": sched._tick,
+                    "path": self.root})
+        return pos
+
+    def _flip_manifest(self, manifest: dict) -> None:
+        path = os.path.join(self.root, CHAIN_MANIFEST)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f, indent=2, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _truncate_to(self, sched, wal_pos) -> None:
+        wal = getattr(sched, "wal", None)
+        if wal is None or wal_pos is None:
+            return
+        from reflow_tpu.wal.log import LogPosition
+
+        wal.truncate_until(LogPosition(*wal_pos))
+
+    def save(self, sched, *, full: Optional[bool] = None) -> dict:
+        """Take one chain element; returns an info dict (kind, element
+        name, tick horizon, anchor, bytes written)."""
+        want_full = (full if full is not None
+                     else (self._shadow is None or self.delta_every <= 1
+                           or self.saves % self.delta_every == 0))
+        if self._shadow is None:
+            want_full = True
+        info = (self._save_full(sched) if want_full
+                else self._save_delta(sched))
+        self.saves += 1
+        return info
+
+    def _save_full(self, sched) -> dict:
+        old = read_chain_manifest(self.root) if os.path.exists(
+            os.path.join(self.root, CHAIN_MANIFEST)) else None
+        name = f"full-{self.saves:06d}"
+        path = os.path.join(self.root, name)
+        # truncate=False: the log must stay intact until the manifest
+        # names this full as the new chain base — a crash between the
+        # save and the flip restores the OLD chain, whose last element
+        # still needs its replay tail
+        save_checkpoint(sched, path, truncate=False)
+        wal = getattr(sched, "wal", None)
+        wal_pos = None
+        if wal is not None:
+            with open(os.path.join(path, "meta.pkl"), "rb") as f:
+                wal_pos = pickle.load(f).get("wal_pos")
+        self._crash_point("ckpt_full_before_flip")
+        manifest = {
+            "schema": CHAIN_SCHEMA,
+            "base": name,
+            "deltas": [],
+            "horizon": sched._tick,
+            "wal_pos": list(wal_pos) if wal_pos is not None else None,
+            "saves": self.saves + 1,
+        }
+        self._flip_manifest(manifest)
+        self._truncate_to(sched, wal_pos)
+        self._gc(old)
+        self._shadow = self._snapshot(sched)
+        self._shadow["wal_pos"] = wal_pos
+        self._shadow["name"] = name
+        self.fulls += 1
+        return {"kind": "full", "element": name, "tick": sched._tick,
+                "wal_pos": wal_pos}
+
+    def _save_delta(self, sched) -> dict:
+        shadow = self._shadow
+        host, arr = self._classify_states(sched.executor.states)
+        host_changed = {nid: blob for nid, blob in host.items()
+                        if shadow["host"].get(nid) != blob}
+        arr_changed = {}
+        for nid, tree in arr.items():
+            blob = pickle.dumps(tree)
+            if shadow["arr_blobs"].get(nid) != blob:
+                arr_changed[nid] = tree
+        view_deltas: Dict[str, Dict] = {}
+        for name, c in sched.sink_views.items():
+            old = shadow["views"].get(name, {})
+            kv = {k: v for k, v in c.items() if old.get(k) != v}
+            kv.update({k: None for k in old if k not in c})
+            if kv:
+                view_deltas[name] = kv
+        new_ids = dict(sched._seen_batch_ids)
+        added = [b for b in new_ids if b not in shadow["ids"]]
+        dropped = len(shadow["ids"]) + len(added) - len(new_ids)
+        wal_pos = self._wal_anchor(sched)
+        payload = {
+            "tick": sched._tick,
+            "base_tick": shadow["tick"],
+            "parent": shadow["name"],
+            "view_deltas": view_deltas,
+            "host_states": host_changed,
+            "array_states": {nid: t for nid, t in arr_changed.items()},
+            "ids_added": added,
+            "ids_dropped": max(0, dropped),
+            "pending": {nid: list(batches)
+                        for nid, batches in sched._pending.items()},
+            "wal_pos": wal_pos,
+        }
+        name = f"delta-{self.saves:06d}.ckd"
+        nbytes = _write_delta_file(os.path.join(self.root, name),
+                                   payload)
+        self._crash_point("ckpt_delta_before_flip")
+        manifest = read_chain_manifest(self.root)
+        manifest["deltas"] = list(manifest.get("deltas", [])) + [name]
+        manifest["horizon"] = sched._tick
+        manifest["wal_pos"] = (list(wal_pos) if wal_pos is not None
+                               else None)
+        manifest["saves"] = self.saves + 1
+        self._flip_manifest(manifest)
+        self._crash_point("ckpt_delta_after_flip")
+        # lag-one truncation: keep the log back to the PREVIOUS
+        # element's anchor, so a torn copy of the element we just wrote
+        # falls back one link and replays its window from the WAL
+        self._truncate_to(sched, shadow.get("wal_pos"))
+        self._shadow = self._snapshot(sched)
+        self._shadow["wal_pos"] = wal_pos
+        self._shadow["name"] = name
+        self.deltas += 1
+        self.delta_bytes += nbytes
+        return {"kind": "delta", "element": name, "tick": sched._tick,
+                "wal_pos": wal_pos, "bytes": nbytes,
+                "changed_sources": sorted(
+                    list(host_changed) + list(arr_changed))}
+
+    def _gc(self, old_manifest: Optional[dict]) -> None:
+        """Drop the superseded chain's elements (best-effort; stray
+        files from a crashed save are harmless and reaped next full)."""
+        import shutil
+
+        if old_manifest is None:
+            return
+        for dname in old_manifest.get("deltas", []):
+            try:
+                os.remove(os.path.join(self.root, dname))
+            except OSError:
+                pass
+        base = old_manifest.get("base")
+        if base:
+            shutil.rmtree(os.path.join(self.root, base),
+                          ignore_errors=True)
+
+    def restore(self, sched) -> Dict:
+        """Reader convenience: :func:`load_chain` over this root."""
+        return load_chain(sched, self.root)

@@ -276,6 +276,27 @@ declare("REFLOW_NET_FAULT_SEED", "int", 0,
         "seed for the wire fault-injection schedule (WireFaults); "
         "same seed = same drops/corruptions/partitions")
 
+# -- bounded history (docs/guide.md 'Bounded history') ----------------------
+
+declare("REFLOW_CKPT_DELTA_EVERY", "int", 8,
+        "CheckpointChain cadence: every Nth save is promoted to a full "
+        "checkpoint; the saves between are cheap delta elements "
+        "(1 = every save full, i.e. deltas disabled)")
+declare("REFLOW_COMPACT_INTERVAL_S", "float", 2.0,
+        "background WAL compactor pass period (seconds)")
+declare("REFLOW_COMPACT_MIN_SEGMENTS", "int", 3,
+        "minimum eligible sealed segments before a compaction pass "
+        "rewrites (smaller ranges are not worth the fold)")
+declare("REFLOW_COMPACT_KEEP_SEGMENTS", "int", 1,
+        "newest sealed segments a compaction pass leaves untouched "
+        "(headroom between the fold and the committer's write head)")
+declare("REFLOW_BENCH_COMPACT", "flag", False,
+        "bench mode: bounded-history recovery/bootstrap — full-history "
+        "replay vs {checkpoint chain + compacted tail}")
+declare("REFLOW_BENCH_COMPACT_TICKS", "int", None,
+        "compact bench batches per producer per leg "
+        "(default 480, smoke 160)")
+
 
 # -- the config dataclass ---------------------------------------------------
 

@@ -12,6 +12,7 @@ idempotent by construction, so exactly-once survives process death,
 torn tail writes, and crashes between ``push`` and ``tick``.
 """
 
+from reflow_tpu.wal.compact import WalCompactor, read_compact_manifest
 from reflow_tpu.wal.durable import DurableScheduler
 from reflow_tpu.wal.log import (FencedWrite, LogPosition, WalError,
                                 WriteAheadLog, scan_wal)
@@ -28,8 +29,10 @@ __all__ = [
     "ShipAck",
     "ShipNack",
     "Shipment",
+    "WalCompactor",
     "WalError",
     "WriteAheadLog",
+    "read_compact_manifest",
     "recover",
     "replay_records",
     "scan_wal",

@@ -36,7 +36,7 @@ import os
 from typing import Optional
 
 from reflow_tpu.delta import DeltaBatch
-from reflow_tpu.wal.log import TornTail, scan_wal
+from reflow_tpu.wal.log import TornTail, WalError, scan_wal
 
 __all__ = ["RecoveryReport", "recover", "replay_records"]
 
@@ -106,6 +106,25 @@ def replay_records(sched, records) -> tuple:
                 # a coalesced frontend feed batch: its micro-batch
                 # ids committed atomically with the macro-tick, so
                 # the replay is all-or-nothing too
+                if (rec.get("compacted")
+                        and not all(b in sched._seen_batch_ids
+                                    for b in ids)):
+                    # a key-level-folded record (wal/compact.py) whose
+                    # ids this scheduler has PARTIALLY seen cannot be
+                    # replayed: the folded batch is the sum of all its
+                    # inputs and has no per-id slice to apply. The
+                    # supported flows keep fold ids disjoint from any
+                    # restore point (folds start at the checkpoint
+                    # anchor; re-anchored followers reset through the
+                    # checkpoint) — hitting this means replaying a
+                    # compacted log against a state cut inside the
+                    # folded range. Fail loud over silent divergence.
+                    raise WalError(
+                        f"compacted record for {rec['node_name']!r} has "
+                        f"{sum(1 for b in ids if b in sched._seen_batch_ids)}"
+                        f"/{len(ids)} already-seen batch ids — state "
+                        f"cut lands inside a folded range; restore "
+                        f"from the checkpoint anchor instead")
                 deduped += 1
             else:
                 for b in ids:
@@ -129,13 +148,17 @@ def recover(sched, wal_dir: str, ckpt_dir: Optional[str] = None,
     ``DirtyScheduler`` or a ``DurableScheduler`` (whose re-logging is
     suspended during replay — the tail segments stay authoritative
     until the next checkpoint truncates them)."""
-    from reflow_tpu.utils.checkpoint import load_checkpoint
+    from reflow_tpu.utils.checkpoint import (checkpoint_exists,
+                                             load_checkpoint)
 
     start = None
     ckpt_loaded = False
     ckpt_tick = 0
-    if ckpt_dir is not None and os.path.exists(
-            os.path.join(ckpt_dir, "meta.pkl")):
+    if ckpt_dir is not None and checkpoint_exists(ckpt_dir):
+        # dispatches on layout: a legacy full checkpoint or an
+        # incremental chain (base + deltas); either way ``wal_pos`` is
+        # the scan anchor and the tail past it may be compacted —
+        # replay of folded records goes through the same dedup below
         meta = load_checkpoint(sched, ckpt_dir)
         ckpt_loaded = True
         ckpt_tick = sched._tick

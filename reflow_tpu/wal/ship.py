@@ -53,6 +53,8 @@ from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 from reflow_tpu.obs import trace as _trace
 from reflow_tpu.utils.runtime import named_lock
 from reflow_tpu.obs.registry import REGISTRY
+from reflow_tpu.wal.compact import (COMPACT_MANIFEST_FILE,
+                                    read_compact_manifest)
 from reflow_tpu.wal.log import (_HEADER, _MAGIC, LogPosition, WalError,
                                 list_segments)
 
@@ -146,7 +148,7 @@ class _FollowerState:
     __slots__ = ("name", "follower", "cursor", "applied_horizon",
                  "bytes_total", "shipments", "nacks", "bootstraps",
                  "fenced", "high_water", "retransmit_bytes",
-                 "link_stalls")
+                 "link_stalls", "anchor_gen", "compact_reanchors")
 
     def __init__(self, name: str, follower) -> None:
         self.name = name
@@ -169,6 +171,13 @@ class _FollowerState:
         #: receive() returned None — link-level no-progress (down,
         #: mid-backoff, reset mid-exchange); NOT a protocol NACK
         self.link_stalls = 0
+        #: compaction generation this follower's cursor was anchored
+        #: under (-1 for a persisted-cursor attach, where the era is
+        #: unknown and any compacted segment forces a conservative
+        #: re-anchor). Mid-segment offsets from an older generation
+        #: point into bytes a compaction pass rewrote.
+        self.anchor_gen = -1
+        self.compact_reanchors = 0
 
 
 class SegmentShipper:
@@ -219,6 +228,14 @@ class SegmentShipper:
         self.retransmit_bytes = 0
         #: link-level no-progress passes (follower.receive() -> None)
         self.link_stalls = 0
+        #: followers re-anchored because their cursor predated a
+        #: compacted range (wal/compact.py) — the truncation re-anchor
+        #: path extended to rewritten-in-place segments
+        self.compact_reanchors = 0
+        #: (mtime_ns, {out_seq: entry}) cache of the compaction
+        #: manifest so the hot shipping path stats instead of parsing
+        self._compact_cache: Tuple[Optional[int], Dict[int, dict]] = \
+            (None, {})
         self._metric_names: List[str] = []
         self._metrics_registry = None
 
@@ -257,9 +274,14 @@ class SegmentShipper:
             self._followers.pop(name, None)
 
     def _bootstrap(self, st: _FollowerState) -> Tuple[int, int]:
+        from reflow_tpu.utils.checkpoint import checkpoint_exists
+
         st.bootstraps += 1
-        if self.ckpt_dir is not None and os.path.exists(
-                os.path.join(self.ckpt_dir, "meta.pkl")):
+        # the re-anchor point is a segment start established *now*:
+        # remember the compaction generation it was minted under so a
+        # later rewrite of that segment invalidates the cursor again
+        st.anchor_gen = self._compact_gen()
+        if self.ckpt_dir is not None and checkpoint_exists(self.ckpt_dir):
             return tuple(st.follower.bootstrap(self.ckpt_dir))
         segs = list_segments(self.wal_dir)
         first = segs[0][0] if segs else 0
@@ -312,7 +334,21 @@ class SegmentShipper:
         if cur.segment not in segs:
             # the leader truncated past this follower's cursor (a
             # checkpoint retired those segments) — re-anchor on the
-            # checkpoint instead of a full refetch
+            # checkpoint instead of a full refetch. Compaction reuses
+            # this path for unlinked middle segments of a folded range.
+            st.cursor = LogPosition(*self._bootstrap(st))
+            return st.cursor != cur
+        ent = self._compact_entries().get(cur.segment)
+        if (ent is not None and ent["gen"] > st.anchor_gen
+                and cur.offset > len(_MAGIC)):
+            # the segment under this mid-segment cursor was rewritten
+            # by a compaction pass from a newer generation: the offset
+            # addresses bytes of the old era. Partially folded replay
+            # would break the all-or-nothing batch-id dedup, so
+            # re-anchor on the checkpoint — the same contract as a
+            # truncation, through the same bootstrap.
+            st.compact_reanchors += 1
+            self.compact_reanchors += 1
             st.cursor = LogPosition(*self._bootstrap(st))
             return st.cursor != cur
         sealed = cur.segment < horizon.segment
@@ -344,6 +380,17 @@ class SegmentShipper:
                 reason = None
             if valid == 0:
                 if reason is not None and sealed:
+                    # before declaring corruption, re-read the
+                    # compaction manifest uncached: a pass may have
+                    # swapped the folded file under our feet between
+                    # the manifest check and the read above
+                    ent = self._compact_entries(force=True) \
+                        .get(cur.segment)
+                    if ent is not None and ent["gen"] > st.anchor_gen:
+                        st.compact_reanchors += 1
+                        self.compact_reanchors += 1
+                        st.cursor = LogPosition(*self._bootstrap(st))
+                        return st.cursor != cur
                     raise WalError(
                         f"wal-{cur.segment:08d}.log @ {cur.offset}: "
                         f"{reason} in a sealed segment below the synced "
@@ -410,6 +457,41 @@ class SegmentShipper:
         later = [s for s in segs if s > seq]
         return min(later) if later else seq + 1
 
+    # -- compaction awareness ----------------------------------------------
+
+    def _compact_entries(self, force: bool = False) -> Dict[int, dict]:
+        """``{out_segment: manifest entry}`` for the leader log's
+        compacted ranges, cached by manifest mtime (flips are atomic,
+        so mtime-staleness is the only hazard and ``force`` closes it
+        on the one path that matters)."""
+        path = os.path.join(self.wal_dir, COMPACT_MANIFEST_FILE)
+        try:
+            mtime = os.stat(path).st_mtime_ns
+        except OSError:
+            self._compact_cache = (None, {})
+            return {}
+        cached_key, cached = self._compact_cache
+        if not force and cached_key == mtime:
+            return cached
+        manifest = read_compact_manifest(self.wal_dir) or {}
+        entries = {e["out"]: e for e in manifest.get("ranges", [])}
+        self._compact_cache = (mtime, entries)
+        return entries
+
+    def _compact_gen(self) -> int:
+        """The current compaction generation (0 = never compacted)."""
+        entries = self._compact_entries()
+        return max((e["gen"] for e in entries.values()), default=0)
+
+    def min_cursor(self) -> Optional[LogPosition]:
+        """The laggiest attached, unfenced follower's cursor — the
+        compactor's eligibility floor: segments at or past it are still
+        being fetched and must not be rewritten under a live cursor."""
+        with self._lock:
+            cursors = [st.cursor for st in self._followers.values()
+                       if not st.fenced and st.cursor is not None]
+        return min(cursors) if cursors else None
+
     # -- backlog / state ---------------------------------------------------
 
     def fully_shipped(self, horizon: Optional[LogPosition] = None) -> bool:
@@ -464,6 +546,7 @@ class SegmentShipper:
                     "shipments": st.shipments,
                     "nacks": st.nacks,
                     "bootstraps": st.bootstraps,
+                    "compact_reanchors": st.compact_reanchors,
                 }
                 tsnap = self._transport_state(st)
                 if tsnap is not None:
@@ -547,6 +630,8 @@ class SegmentShipper:
         reg.gauge(f"{name}.nacks", lambda: self.nacks)
         reg.gauge(f"{name}.followers", lambda: len(self._followers))
         reg.gauge(f"{name}.link_stalls", lambda: self.link_stalls)
+        reg.gauge(f"{name}.compact_reanchors",
+                  lambda: self.compact_reanchors)
         reg.gauge("net.reconnects_total", self._net_reconnects_total)
         reg.gauge("net.retransmit_bytes", lambda: self.retransmit_bytes)
         self._metric_names.append(name)

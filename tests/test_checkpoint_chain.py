@@ -1,0 +1,292 @@
+"""Incremental checkpoint chains (``utils.checkpoint.CheckpointChain``):
+base-plus-deltas restore parity, the lag-one WAL truncation contract (a
+torn FINAL delta falls back one element and replays its window from the
+log; a broken mid-chain link fails loud), differential crash tests at
+the manifest-flip seams, replica bootstrap from a leader chain, and the
+tier-wide checkpoint barrier — one consistent macro-tick cut across
+every graph in a ServeTier."""
+
+import os
+
+import numpy as np
+import pytest
+
+from reflow_tpu import DirtyScheduler
+from reflow_tpu.graph import GraphError
+from reflow_tpu.serve import (CoalesceWindow, GraphConfig, ReplicaScheduler,
+                              ServeTier)
+from reflow_tpu.utils.checkpoint import (CheckpointChain, CheckpointError,
+                                         chain_head_wal_pos,
+                                         checkpoint_exists, load_chain,
+                                         load_checkpoint,
+                                         read_chain_manifest)
+from reflow_tpu.utils.faults import CrashInjector, CrashPoint
+from reflow_tpu.wal import DurableScheduler, SegmentShipper, recover
+from reflow_tpu.wal.log import list_segments
+from reflow_tpu.workloads import wordcount
+
+WINDOW = CoalesceWindow(max_rows=256, max_ticks=8, max_latency_s=0.002)
+
+
+def make_leader(tmp_path, **kw):
+    g, src, sink = wordcount.build_graph()
+    kw.setdefault("segment_bytes", 1 << 12)
+    sched = DurableScheduler(g, wal_dir=str(tmp_path / "wal"),
+                             fsync="tick", **kw)
+    return sched, src, sink
+
+
+def drive(sched, src, n_ticks, seed=0, start=0):
+    rng = np.random.default_rng(seed + start)
+    for t in range(start, start + n_ticks):
+        for j in range(2):
+            words = " ".join(f"w{int(x)}" for x in rng.integers(0, 40, 8))
+            sched.push(src, wordcount.ingest_lines([words]),
+                       batch_id=f"t{t}b{j}")
+        sched.tick()
+
+
+def fresh_view(tmp_path, ckpt_dir=None):
+    """Recover a fresh scheduler from the leader's WAL (+ chain) and
+    return (view, tick, report)."""
+    g, _src, sink = wordcount.build_graph()
+    sched = DirtyScheduler(g)
+    rep = recover(sched, str(tmp_path / "wal"), ckpt_dir)
+    return dict(sched.view(sink.name)), sched._tick, rep
+
+
+# -- save/restore parity ----------------------------------------------------
+
+def test_chain_full_delta_restore_parity(tmp_path):
+    sched, src, sink = make_leader(tmp_path)
+    root = str(tmp_path / "ckpt")
+    chain = CheckpointChain(root, delta_every=4)
+    infos = []
+    for r in range(8):
+        drive(sched, src, 3, start=3 * r)
+        infos.append(chain.save(sched))
+    want = dict(sched.view(sink.name))
+    tick = sched._tick
+    ids = dict(sched._seen_batch_ids)
+    sched.close()
+    # save cadence: first save full, then delta_every-1 deltas per full
+    assert [i["kind"] for i in infos[:5]] \
+        == ["full", "delta", "delta", "delta", "full"]
+    assert chain.fulls == 2 and chain.deltas == 6
+    m = read_chain_manifest(root)
+    assert m["horizon"] == tick and len(m["deltas"]) == 3
+    assert checkpoint_exists(root)
+    g2, _s2, sink2 = wordcount.build_graph()
+    sched2 = DirtyScheduler(g2)
+    meta = load_chain(sched2, root)
+    assert meta["chain"]["deltas_applied"] == 3
+    assert meta["chain"]["fallback"] is None
+    assert dict(sched2.view(sink2.name)) == want
+    assert sched2._tick == tick
+    assert dict(sched2._seen_batch_ids) == ids  # exactly-once horizon
+    # load_checkpoint dispatches on the chain manifest transparently
+    g3, _s3, sink3 = wordcount.build_graph()
+    sched3 = DirtyScheduler(g3)
+    assert load_checkpoint(sched3, root)["tick"] == tick
+    assert dict(sched3.view(sink3.name)) == want
+
+
+def test_chain_recover_replays_post_anchor_tail(tmp_path):
+    # ticks after the last chain element live only in the WAL; recover
+    # must restore the chain then replay exactly that window
+    sched, src, sink = make_leader(tmp_path)
+    root = str(tmp_path / "ckpt")
+    chain = CheckpointChain(root, delta_every=3)
+    drive(sched, src, 5)
+    chain.save(sched)
+    drive(sched, src, 4, start=5)
+    chain.save(sched)
+    drive(sched, src, 6, start=9)      # un-checkpointed tail
+    want = dict(sched.view(sink.name))
+    tick = sched._tick
+    sched.close()
+    got, got_tick, rep = fresh_view(tmp_path, root)
+    assert got == want and got_tick == tick
+    assert rep.checkpoint_loaded and rep.checkpoint_tick == 9
+    assert rep.replayed_ticks == 6
+    # lag-one truncation bounded the log: segments before the PREVIOUS
+    # element's anchor are gone
+    anchor = chain_head_wal_pos(root)
+    segs = [s for s, _ in list_segments(str(tmp_path / "wal"))]
+    assert segs and segs[-1] >= anchor[0]
+
+
+def test_torn_final_delta_falls_back_one_element(tmp_path):
+    # the torn tail of the CHAIN: restore falls back one element and
+    # the WAL window the lag-one truncation kept replays the gap
+    sched, src, sink = make_leader(tmp_path)
+    root = str(tmp_path / "ckpt")
+    chain = CheckpointChain(root, delta_every=8)
+    drive(sched, src, 4)
+    chain.save(sched)
+    drive(sched, src, 4, start=4)
+    chain.save(sched)
+    drive(sched, src, 4, start=8)
+    chain.save(sched)
+    want = dict(sched.view(sink.name))
+    tick = sched._tick
+    sched.close()
+    last = read_chain_manifest(root)["deltas"][-1]
+    path = os.path.join(root, last)
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 7)
+    g2, _s2, _k2 = wordcount.build_graph()
+    meta = load_chain(DirtyScheduler(g2), root)
+    assert meta["chain"]["fallback"] is not None
+    assert meta["chain"]["deltas_applied"] == 1  # fell back one link
+    got, got_tick, rep = fresh_view(tmp_path, root)
+    assert got == want and got_tick == tick
+    assert rep.replayed_ticks == 4  # the torn element's window, from WAL
+
+
+def test_broken_mid_chain_link_fails_loud(tmp_path):
+    # corruption anywhere NOT at the tail is real damage: no silent
+    # partial restore, no guessing — CheckpointError
+    sched, src, _sink = make_leader(tmp_path)
+    root = str(tmp_path / "ckpt")
+    chain = CheckpointChain(root, delta_every=8)
+    for r in range(3):
+        drive(sched, src, 3, start=3 * r)
+        chain.save(sched)
+    sched.close()
+    first_delta = read_chain_manifest(root)["deltas"][0]
+    with open(os.path.join(root, first_delta), "r+b") as f:
+        f.seek(12)
+        f.write(b"\xff\xff\xff")
+    g2, _s2, _k2 = wordcount.build_graph()
+    with pytest.raises(CheckpointError):
+        load_chain(DirtyScheduler(g2), root)
+    os.remove(os.path.join(root, first_delta))
+    g3, _s3, _k3 = wordcount.build_graph()
+    with pytest.raises(CheckpointError):
+        load_chain(DirtyScheduler(g3), root)
+
+
+# -- crash seams ------------------------------------------------------------
+
+@pytest.mark.parametrize("seam,full_crash", [
+    ("ckpt_full_before_flip", True),
+    ("ckpt_delta_before_flip", False),
+    ("ckpt_delta_after_flip", False),
+])
+def test_chain_crash_seam_differential(tmp_path, seam, full_crash):
+    # kill a save at each manifest seam: before the flip the OLD chain
+    # plus its replay tail must reconstruct the crash-time state; after
+    # the flip the NEW one must (truncation lags, replay dedups)
+    # each seam occurs once in the two setup saves (full #1 + delta #2)
+    # and once in the killed save below — at=2 targets the latter
+    crash = CrashInjector(2, only=seam)
+    sched, src, sink = make_leader(tmp_path)
+    root = str(tmp_path / "ckpt")
+    chain = CheckpointChain(root, delta_every=4, crash=crash)
+    drive(sched, src, 4)
+    chain.save(sched)                      # full #1
+    drive(sched, src, 4, start=4)
+    chain.save(sched)                      # delta #2
+    drive(sched, src, 4, start=8)
+    want = dict(sched.view(sink.name))
+    tick = sched._tick
+    with pytest.raises(CrashPoint):
+        chain.save(sched, full=full_crash)
+    sched.close()
+    got, got_tick, rep = fresh_view(tmp_path, root)
+    assert got == want and got_tick == tick, f"{seam}: diverged"
+    assert rep.checkpoint_loaded
+
+
+# -- replica bootstrap from a leader chain ----------------------------------
+
+def test_replica_bootstrap_from_chain_dir(tmp_path):
+    # a fresh replica attaching to a chain-checkpointed leader must
+    # bootstrap O(state) — chain restore + compacted/short tail — and
+    # land on exact view parity
+    sched, src, sink = make_leader(tmp_path)
+    root = str(tmp_path / "ckpt")
+    chain = CheckpointChain(root, delta_every=4)
+    ship = SegmentShipper(sched.wal, ckpt_dir=root,
+                          leader_tick=lambda: sched._tick)
+    for r in range(4):
+        drive(sched, src, 3, start=3 * r)
+        chain.save(sched)
+    drive(sched, src, 3, start=12)
+    sched.wal.sync()
+    g2, _s2, sink2 = wordcount.build_graph()
+    replica = ReplicaScheduler(g2, str(tmp_path / "r0"), name="r0")
+    ship.attach(replica)
+    assert replica.bootstraps == 1
+    for _ in range(200):
+        ship.pump_once()
+        if replica.published_horizon() == sched._tick:
+            break
+    h, got = replica.view_at(sink2.name)
+    want = {kv: w for kv, w in sched.view(sink.name).items() if w != 0}
+    assert h == sched._tick and got == want
+    # the replica restored through the chain, not by full-history replay
+    assert replica.restored_from is not None or replica.bootstraps == 1
+    sched.close()
+
+
+# -- tier-wide checkpoint barrier -------------------------------------------
+
+def test_tier_checkpoint_barrier_consistent_cut(tmp_path):
+    tier = ServeTier(max_bytes=1 << 20, pump_threads=2)
+    handles = {}
+    for i in range(3):
+        g, src, sink = wordcount.build_graph()
+        sched = DirtyScheduler(g)
+        h = tier.register(f"g{i}", sched, GraphConfig(window=WINDOW))
+        handles[f"g{i}"] = (h, src, sink, sched)
+    for name, (h, src, _sink, _sched) in handles.items():
+        for j in range(6):
+            h.submit(src, wordcount.ingest_lines([f"{name} w{j}"])) \
+                .result(timeout=10)
+        h.flush(timeout=10)
+    chains = {n: CheckpointChain(str(tmp_path / n), delta_every=4)
+              for n in handles}
+
+    def saver(name, h):
+        return chains[name].save(h.frontend.sched)
+
+    out = tier.checkpoint_barrier(saver)
+    assert out["barrier"] == 1 and tier.barriers == 1
+    assert set(out["horizons"]) == set(handles)
+    for name, (h, _src, sink, sched) in handles.items():
+        # the recorded horizon is the quiesced macro-tick cut, and the
+        # chain manifest agrees with it
+        assert out["horizons"][name] == sched._tick
+        assert read_chain_manifest(str(tmp_path / name))["horizon"] \
+            == sched._tick
+        assert out["results"][name]["kind"] == "full"
+        g2, _s2, sink2 = wordcount.build_graph()
+        s2 = DirtyScheduler(g2)
+        load_chain(s2, str(tmp_path / name))
+        assert dict(s2.view(sink2.name)) == dict(sched.view(sink.name))
+    # the tier keeps serving after the barrier
+    for name, (h, src, _sink, _sched) in handles.items():
+        assert h.submit(src, wordcount.ingest_lines(["after barrier"])) \
+            .result(timeout=10).applied
+    tier.close()
+
+
+def test_tier_checkpoint_barrier_resumes_after_saver_error(tmp_path):
+    tier = ServeTier(max_bytes=1 << 20, pump_threads=1)
+    g, src, _sink = wordcount.build_graph()
+    h = tier.register("g", DirtyScheduler(g), GraphConfig(window=WINDOW))
+    h.submit(src, wordcount.ingest_lines(["a b"])).result(timeout=10)
+
+    def bad_saver(name, handle):
+        raise RuntimeError("disk full")
+
+    with pytest.raises(RuntimeError, match="disk full"):
+        tier.checkpoint_barrier(bad_saver)
+    # every frontend was resumed on the way out
+    assert h.submit(src, wordcount.ingest_lines(["c d"])) \
+        .result(timeout=10).applied
+    tier.close()
+    with pytest.raises(GraphError):
+        tier.checkpoint_barrier(lambda n, hh: None)

@@ -313,4 +313,37 @@ print(f"TIER1 chaos smoke: {r['acked_batches']} acked batches, zero "
       f"({r['ex_leader_fence_nacks']} NACK(s), 0 ACKs)")
 EOF
 fi
+
+# optional (RUN_BENCH=1): the bounded-history smoke — two identically-
+# fed 16-producer legs (unbounded oracle vs incremental checkpoint
+# chain + key-level WAL compaction): history >= 10x live state, leader
+# crash-recovery AND fresh-replica bootstrap each >= 5x faster than
+# full-history replay and within 2x of a fresh-full-checkpoint
+# restore, exact view parity everywhere, zero acked-write loss, and a
+# bounded on-disk footprint after the final compaction pass.
+if [ "${RUN_BENCH:-0}" = "1" ] && [ $rc -eq 0 ]; then
+  REFLOW_BENCH_COMPACT=1 REFLOW_BENCH_SMOKE=1 JAX_PLATFORMS=cpu \
+    timeout -k 10 300 python bench.py --json-out /tmp/_t1_compact.json \
+    > /dev/null || rc=3
+  python - <<'EOF' || rc=3
+import json
+r = json.load(open("/tmp/_t1_compact.json"))
+assert r["parity_max_abs_diff"] == 0, r
+assert r["zero_acked_loss"], r
+assert r["history_ratio_ok"], r
+assert r["recover_speedup_ok"], r
+assert r["bootstrap_speedup_ok"], r
+assert r["recover_near_floor_ok"], r
+assert r["bootstrap_near_floor_ok"], r
+assert r["footprint_bounded_ok"], r
+assert r["chain_saves"] >= 1 and r["compact_folds"] >= 1, r
+print(f"TIER1 compact smoke: history {r['history_ratio']}x state — "
+      f"recover {r['recover_speedup_x']}x, bootstrap "
+      f"{r['bootstrap_speedup_x']}x vs full replay (floor "
+      f"{r['fresh_full_restore_s']}s); {r['acked_batches']} acked "
+      f"batches, parity exact, zero loss; {r['compact_folds']} "
+      f"fold(s), {r['chain_saves']} chain save(s), footprint "
+      f"{r['wal_bounded_bytes']}/{r['wal_full_bytes']} bytes")
+EOF
+fi
 exit $rc

@@ -53,7 +53,8 @@ def _describe(rec: dict) -> str:
     return f"{kind}?  {sorted(rec)}"
 
 
-def inspect(wal_dir: str, *, verbose: bool = True) -> dict:
+def inspect(wal_dir: str, *, verbose: bool = True,
+            ckpt_dir: str = None) -> dict:
     """Scan + summarize; the dict is the machine-readable result."""
     segs = list_segments(wal_dir)
     records, torn = scan_wal(wal_dir)
@@ -107,6 +108,15 @@ def inspect(wal_dir: str, *, verbose: bool = True) -> dict:
         push_runs.append(run)
     win = np.asarray(push_runs, dtype=float)
     shipping = _ship_summary(wal_dir, per_seg)
+    compaction = _compact_summary(wal_dir, per_seg)
+    ckpt_roots = []
+    if ckpt_dir is not None:
+        ckpt_roots.append(ckpt_dir)
+    for _pos, rec in records:
+        p = rec.get("path")
+        if (rec.get("kind") == "ckpt" and p
+                and p not in ckpt_roots):
+            ckpt_roots.append(p)
     return {
         # same schema family as reflow_tpu.obs snapshots / trace_inspect
         "schema": "reflow.wal_inspect/1",
@@ -129,9 +139,86 @@ def inspect(wal_dir: str, *, verbose: bool = True) -> dict:
             float(np.percentile(win, 95)) if len(win) else 0.0),
         "segments_detail": [per_seg[s] for s in sorted(per_seg)],
         "shipping": shipping,
+        "compaction": compaction,
+        "checkpoint_chain": _chain_summary(ckpt_roots),
         "epochs": _epoch_summary(wal_dir, max_epoch),
         "torn_tail": torn._asdict() if torn is not None else None,
     }
+
+
+def _compact_summary(wal_dir: str, per_seg: dict):
+    """Merge the compactor's persisted manifest (wal/compact.py writes
+    ``compact-manifest.json`` next to the segments) into the summary
+    and stamp each live segment's compaction status. None when this log
+    was never compacted."""
+    path = os.path.join(wal_dir, "compact-manifest.json")
+    if not os.path.exists(path):
+        return None
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError) as e:
+        return {"error": f"unreadable compact-manifest.json: {e}"}
+    ranges = manifest.get("ranges", [])
+    covered = 0
+    for ent in ranges:
+        a, b = ent["covers"]
+        covered += b - a + 1
+        for seg in per_seg.values():
+            s = seg["segment"]
+            if s == ent["out"]:
+                seg["compacted"] = {"covers": [a, b], "gen": ent["gen"],
+                                    "records_in": ent["records_in"],
+                                    "records_out": ent["records_out"]}
+            elif a < s <= b:
+                # still on disk inside a folded range: superseded by
+                # the out segment, awaiting (or surviving a crashed)
+                # unlink — replay-harmless, its ids dedup away
+                seg["superseded_by"] = ent["out"]
+    return {
+        "gen": manifest.get("gen"),
+        "ranges": ranges,
+        "segments_covered": covered,
+        "reclaimed_bytes": manifest.get("reclaimed_bytes", 0),
+    }
+
+
+def _chain_summary(roots: list):
+    """Incremental-checkpoint chains reachable from this log: every
+    ``ckpt`` record's path (plus an explicit ``--ckpt``) that holds a
+    ``chain.json`` manifest (utils/checkpoint.py). None when no chain
+    is found — a legacy full checkpoint has no chain to report."""
+    chains = []
+    for root in roots:
+        mpath = os.path.join(root, "chain.json")
+        if not os.path.exists(mpath):
+            continue
+        try:
+            with open(mpath) as f:
+                m = json.load(f)
+        except (OSError, ValueError) as e:
+            chains.append({"root": root,
+                           "error": f"unreadable chain.json: {e}"})
+            continue
+        deltas = m.get("deltas", [])
+        delta_bytes = 0
+        missing = []
+        for d in deltas:
+            try:
+                delta_bytes += os.path.getsize(os.path.join(root, d))
+            except OSError:
+                missing.append(d)
+        chains.append({
+            "root": root,
+            "base": m.get("base"),
+            "deltas": len(deltas),
+            "delta_bytes": delta_bytes,
+            "horizon": m.get("horizon"),
+            "wal_pos": m.get("wal_pos"),
+            "saves": m.get("saves"),
+            "broken_links": missing,
+        })
+    return chains or None
 
 
 def _epoch_summary(wal_dir: str, record_max: int):
@@ -211,9 +298,13 @@ def main(argv=None) -> int:
                     help="exit 1 on sealed-segment corruption")
     ap.add_argument("--json", action="store_true",
                     help="print the summary as one JSON line (no dump)")
+    ap.add_argument("--ckpt", default=None,
+                    help="checkpoint/chain directory to summarize (in "
+                         "addition to any 'ckpt' record paths)")
     args = ap.parse_args(argv)
     try:
-        summary = inspect(args.wal_dir, verbose=not args.json)
+        summary = inspect(args.wal_dir, verbose=not args.json,
+                          ckpt_dir=args.ckpt)
     except WalError as e:
         print(f"CORRUPT: {e}", file=sys.stderr)
         return 1
@@ -240,10 +331,35 @@ def main(argv=None) -> int:
             if ship and "followers" in ship:
                 shipped = (f" shipped={seg.get('shipped_followers', 0)}/"
                            f"{len(ship['followers'])} follower(s)")
+            comp = seg.get("compacted")
+            if comp:
+                shipped += (f" compacted[{comp['covers'][0]}"
+                            f"..{comp['covers'][1]} gen={comp['gen']} "
+                            f"{comp['records_in']}→"
+                            f"{comp['records_out']} rec]")
+            if seg.get("superseded_by") is not None:
+                shipped += f" SUPERSEDED by {seg['superseded_by']:08d}"
             print(f"segment {seg['segment']:08d}: {seg['bytes']:>8} bytes "
                   f"{seg['records']:>5} record(s) {seg['pushes']:>5} "
                   f"push(es) {seg['rows']:>7} row(s) "
                   f"{seg['micro_batches']:>5} micro-batch(es){shipped}")
+        compaction = summary["compaction"]
+        if compaction and "ranges" in compaction:
+            print(f"compaction: gen={compaction['gen']} "
+                  f"{len(compaction['ranges'])} range(s) covering "
+                  f"{compaction['segments_covered']} segment(s), "
+                  f"reclaimed={compaction['reclaimed_bytes']} bytes")
+        for ch in summary["checkpoint_chain"] or []:
+            if "error" in ch:
+                print(f"chain {ch['root']}: {ch['error']}")
+                continue
+            broken = (f" BROKEN links: {ch['broken_links']}"
+                      if ch["broken_links"] else "")
+            print(f"chain {ch['root']}: base={ch['base']} "
+                  f"+{ch['deltas']} delta(s) "
+                  f"({ch['delta_bytes']} bytes) "
+                  f"horizon={ch['horizon']} "
+                  f"wal_pos={ch['wal_pos']}{broken}")
         if ship and "followers" in ship:
             print(f"shipping: horizon={tuple(ship['horizon'])} "
                   f"leader_tick={ship['leader_tick']} "
