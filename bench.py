@@ -167,8 +167,25 @@ Env knobs::
                                   (CPU-only)
     REFLOW_BENCH_CHAOS_N          follower count            (default 3)
     REFLOW_BENCH_CHAOS_RUN_S      write window (s)          (default 1.2)
+    REFLOW_BENCH_FLEETOBS=1       fleet-telemetry mode instead: the
+                                  replicated TCP topology with a
+                                  TelemetryShipper per node streaming
+                                  registry snapshots to a live
+                                  FleetAggregator; reports the write-
+                                  path overhead (off vs on, best-of-2,
+                                  <3% on an uncontended host), asserts
+                                  aggregator horizons == ground truth
+                                  at quiesce, >= 1 post-heal causal
+                                  chain ship_segment->net_send->
+                                  replica_replay, and that the fleet
+                                  view serves stale-marked through a
+                                  telemetry-link partition (CPU-only)
+    REFLOW_BENCH_FLEETOBS_BATCHES fixed-work batches per producer for
+                                  the A/B legs (default 320, smoke 160)
     REFLOW_TRACE_OUT              obs-mode chrome trace path
-                                  (default /tmp/reflow_obs_trace.json)
+                                  (default /tmp/reflow_obs_trace.json;
+                                  fleetobs default
+                                  /tmp/reflow_fleet_trace.json)
 
 Every mode also accepts ``--json-out PATH``: the final result object is
 written there (pretty-printed) in addition to the stdout JSON line.
@@ -2232,6 +2249,444 @@ def run_chaos_bench() -> dict:
     return out
 
 
+# -- fleet-telemetry mode (REFLOW_BENCH_FLEETOBS=1) ------------------------
+
+def run_fleetobs_bench() -> dict:
+    """Fleet-telemetry-plane numbers (docs/guide.md "Fleet telemetry"),
+    two parts on the replicated topology (leader + N replicas over
+    real TCP, 16 producers):
+
+    A. **write-path overhead** — the same fixed work (16 producers x K
+       batches through the frontend, WAL shipped to every replica) run
+       with the telemetry plane fully off vs fully on (tracing +
+       per-node registries + per-node :class:`TelemetryShipper` at the
+       production ship interval streaming to a live
+       :class:`FleetAggregator` over TCP), best-of-2 walls per mode;
+       acceptance: overhead < 3% on an uncontended host. Like the obs
+       bench's bound this is *recorded*, not asserted — on a shared
+       1-core CI box the wall noise between identical legs dwarfs 3% —
+       while the structural proofs in part B are hard asserts.
+    B. **fleet proofs under chaos** — the telemetry-enabled topology
+       with every data link behind seeded :class:`WireFaults` runs a
+       storm, then a partition/heal cycle on the last data link; after
+       the heal the trace rings are reset so every causal chain in the
+       export is post-heal evidence (``trace_inspect
+       --require-chain ship_segment,net_send,replica_replay`` >= 1).
+       At quiesce the aggregator's per-node horizons / lag / spread
+       must EQUAL ground truth read directly off the replicas. Then
+       the telemetry link of one node is partitioned: the aggregator
+       must keep answering ``fetch_fleet`` with that node stale-marked
+       (never an error), and recover once the link heals.
+
+    Host-side CPU work; runs on the CPU executor/platform."""
+    import importlib.util
+    import shutil
+    import tempfile
+    import threading
+
+    from reflow_tpu import obs
+    from reflow_tpu.net import (FaultyTransport, ReconnectPolicy,
+                                RemoteFollower, ReplicaServer,
+                                TcpTransport)
+    from reflow_tpu.obs.fleet import FleetAggregator, TelemetryShipper
+    from reflow_tpu.obs.wire import TelemetryLink, TelemetryServer
+    from reflow_tpu.serve import (CoalesceWindow, IngestFrontend,
+                                  LeaderReadAdapter, ReadTier,
+                                  ReplicaScheduler)
+    from reflow_tpu.utils.faults import WireFaults
+    from reflow_tpu.wal import DurableScheduler, SegmentShipper
+    from reflow_tpu.workloads import wordcount
+
+    smoke = env_flag("REFLOW_BENCH_SMOKE")
+    n_replicas = max(2, env_int("REFLOW_BENCH_CHAOS_N", "3"))
+    n_prod = 16
+    rows_per_batch = 8
+    per_producer = env_int("REFLOW_BENCH_FLEETOBS_BATCHES",
+                           "160" if smoke else "320")
+    run_s = env_float("REFLOW_BENCH_CHAOS_RUN_S",
+                      "0.3" if smoke else "0.8")
+    fault_seed = env_int("REFLOW_NET_FAULT_SEED", "0")
+    ship_interval = 0.05
+    window_ticks = 4
+
+    out = {"replicas": n_replicas, "producers": n_prod,
+           "per_producer_batches": per_producer,
+           "rows_per_batch": rows_per_batch, "run_s": run_s,
+           "fault_seed": fault_seed}
+    tmp = tempfile.mkdtemp(prefix="reflow-fleetobs-")
+
+    def make_lines(producer: int, j: int) -> list:
+        rng = np.random.default_rng(producer * 100_003 + j)
+        return [" ".join(f"w{int(x)}"
+                         for x in rng.integers(0, 1000, rows_per_batch))]
+
+    # -- part A: fixed-work A/B on the clean replicated topology ----------
+
+    def run_fixed(root: str, telemetry: bool) -> float:
+        """One fixed-work pass; rows/s. Identical topology both ways —
+        only the telemetry plane differs."""
+        fe = ship = tsrv = agg = sched = None
+        replicas, servers, shippers, regs = [], [], [], []
+        try:
+            g, src, _sink = wordcount.build_graph()
+            sched = DurableScheduler(g, wal_dir=os.path.join(root, "wal"),
+                                     fsync="tick", committer="thread",
+                                     segment_bytes=1 << 20)
+            fe = IngestFrontend(sched, window=CoalesceWindow(
+                max_rows=65536, max_ticks=window_ticks,
+                max_latency_s=0.002))
+            ship = SegmentShipper(sched.wal,
+                                  leader_tick=lambda: sched._tick,
+                                  poll_s=0.001)
+            for i in range(n_replicas):
+                gr, _s, _k = wordcount.build_graph()
+                r = ReplicaScheduler(gr, os.path.join(root, f"r{i}"),
+                                     name=f"r{i}")
+                srv = ReplicaServer(r, TcpTransport()).start()
+                link = RemoteFollower(
+                    TcpTransport(), srv.address, name=f"r{i}",
+                    policy=ReconnectPolicy(f"r{i}", base_s=0.005,
+                                           cap_s=0.05, seed=fault_seed),
+                    io_timeout_s=0.2)
+                ship.attach(link)
+                replicas.append(r)
+                servers.append(srv)
+            if telemetry:
+                obs.trace.reset()
+                obs.enable()
+                agg = FleetAggregator(retention=64, stale_after_s=2.0)
+                tsrv = TelemetryServer(agg, TcpTransport()).start()
+                reg_leader = obs.MetricsRegistry()
+                fe.publish_metrics(reg_leader)
+                ship.publish_metrics(reg_leader)
+                regs.append(("leader", reg_leader))
+                for i, r in enumerate(replicas):
+                    reg_r = obs.MetricsRegistry()
+                    r.publish_metrics(reg_r)
+                    regs.append((f"r{i}", reg_r))
+                for node, reg in regs:
+                    # production-default ship interval: the A/B legs
+                    # price the plane as deployed, not the fast beat
+                    # part B uses to exercise staleness
+                    sh = TelemetryShipper(
+                        reg, TcpTransport(), tsrv.address, node=node,
+                        policy=ReconnectPolicy(f"tele/{node}",
+                                               base_s=0.005, cap_s=0.05,
+                                               seed=fault_seed),
+                        io_timeout_s=0.5)
+                    sh.publish_metrics()
+                    shippers.append(sh.start())
+            else:
+                obs.disable()
+                obs.trace.reset()
+            ship.start()
+
+            tickets: list = []
+            tk_lock = threading.Lock()
+
+            def produce(pid, fe=fe, src=src):
+                mine = [fe.submit(src, wordcount.ingest_lines(
+                    make_lines(pid, j))) for j in range(per_producer)]
+                with tk_lock:
+                    tickets.extend(mine)
+
+            threads = [threading.Thread(target=produce, args=(pid,))
+                       for pid in range(n_prod)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            fe.flush()
+            wall = time.perf_counter() - t0
+            assert all(t.result(timeout=30).applied for t in tickets)
+            return n_prod * per_producer * rows_per_batch / wall
+        finally:
+            for sh in shippers:
+                sh.close()
+            if tsrv is not None:
+                tsrv.close()
+            if agg is not None:
+                agg.close()
+            if fe is not None:
+                fe.close()
+            if ship is not None:
+                ship.close()
+            for srv in servers:
+                srv.close()
+            for r in replicas:
+                r.close()
+            if sched is not None:
+                sched.wal.close()
+            obs.disable()
+
+    try:
+        rate_off = max(run_fixed(os.path.join(tmp, f"off{k}"), False)
+                       for k in range(2))
+        rate_on = max(run_fixed(os.path.join(tmp, f"on{k}"), True)
+                      for k in range(2))
+        out["disabled_rows_per_s"] = round(rate_off)
+        out["enabled_rows_per_s"] = round(rate_on)
+        overhead = 1.0 - rate_on / rate_off
+        out["fleetobs_overhead_frac"] = round(overhead, 4)
+        out["fleetobs_overhead_lt_3pct"] = overhead < 0.03
+        log(f"fleetobs: off {rate_off:.0f} rows/s, on {rate_on:.0f} "
+            f"rows/s (overhead {100 * overhead:.2f}%)")
+
+        # -- part B: fleet proofs on the faulted topology ------------------
+        fe = ship = tsrv = agg = probe = sched = None
+        replicas, servers, links, faults = [], [], [], []
+        shippers, tele_faults, producers = [], [], []
+        stop = threading.Event()
+        try:
+            obs.trace.reset()
+            obs.enable()
+            g, src, sink = wordcount.build_graph()
+            sched = DurableScheduler(g, wal_dir=os.path.join(tmp, "wal"),
+                                     fsync="tick", committer="thread",
+                                     segment_bytes=1 << 20)
+            fe = IngestFrontend(sched, window=CoalesceWindow(
+                max_rows=65536, max_ticks=window_ticks,
+                max_latency_s=0.002))
+            ship = SegmentShipper(sched.wal,
+                                  leader_tick=lambda: sched._tick,
+                                  poll_s=0.001)
+            for i in range(n_replicas):
+                gr, _s, _k = wordcount.build_graph()
+                r = ReplicaScheduler(gr, os.path.join(tmp, f"br{i}"),
+                                     name=f"r{i}")
+                srv = ReplicaServer(r, TcpTransport()).start()
+                wf = WireFaults(seed=fault_seed + 17 * i + 1)
+                link = RemoteFollower(
+                    FaultyTransport(TcpTransport(), wf), srv.address,
+                    name=f"r{i}",
+                    policy=ReconnectPolicy(f"r{i}", base_s=0.005,
+                                           cap_s=0.05, seed=fault_seed),
+                    io_timeout_s=0.05)
+                ship.attach(link)
+                replicas.append(r)
+                servers.append(srv)
+                links.append(link)
+                faults.append(wf)
+            tier = ReadTier(replicas, leader=LeaderReadAdapter(sched))
+            for r, link in zip(replicas, links):
+                tier.bind_link(r, link)
+
+            # the telemetry plane: one registry + shipper per node,
+            # every telemetry link behind its OWN WireFaults pair
+            agg = FleetAggregator(retention=64, stale_after_s=0.35)
+            tsrv = TelemetryServer(agg, TcpTransport()).start()
+            reg_leader = obs.MetricsRegistry()
+            fe.publish_metrics(reg_leader)
+            ship.publish_metrics(reg_leader)
+            tier.publish_metrics(reg_leader)
+            node_regs = [("leader", reg_leader)]
+            for i, r in enumerate(replicas):
+                reg_r = obs.MetricsRegistry()
+                r.publish_metrics(reg_r)
+                node_regs.append((f"r{i}", reg_r))
+            for node, reg in node_regs:
+                tf = WireFaults(seed=fault_seed + 91 + len(tele_faults))
+                sh = TelemetryShipper(
+                    reg, FaultyTransport(TcpTransport(), tf),
+                    tsrv.address, node=node, interval_s=ship_interval,
+                    policy=ReconnectPolicy(f"tele/{node}", base_s=0.005,
+                                           cap_s=0.05, seed=fault_seed),
+                    io_timeout_s=0.25)
+                sh.publish_metrics()
+                tele_faults.append(tf)
+                shippers.append(sh.start())
+            ship.start()
+
+            def produce(pid):
+                rng = np.random.default_rng(1000 + pid)
+                seq = 0
+                while not stop.is_set():
+                    words = " ".join(
+                        f"w{int(x)}" for x in rng.integers(0, 1000, 24))
+                    bid = f"p{pid}-{seq}"
+                    batch = wordcount.ingest_lines([words])
+                    deadline = time.monotonic() + 60
+                    while time.monotonic() < deadline:
+                        res = fe.submit(src, batch,
+                                        batch_id=bid).result(timeout=60)
+                        if res.status in ("applied", "deduped"):
+                            break
+                        time.sleep(0.001)
+                    seq += 1
+
+            producers.extend(
+                threading.Thread(target=produce, args=(pid,))
+                for pid in range(n_prod))
+            for t in producers:
+                t.start()
+
+            # storm on every data link, then partition + heal the last
+            for wf in faults:
+                wf.set_rates(drop_c2s=0.03, drop_s2c=0.03, dup=0.03,
+                             reorder=0.03, corrupt_frame=0.01,
+                             delay_p=0.05, delay_s=0.002)
+            time.sleep(run_s)
+            target = n_replicas - 1
+            faults[target].partition("c2s")
+            time.sleep(0.15)
+            faults[target].heal()
+            for wf in faults:
+                wf.quiesce()
+            # post-heal evidence window: reset the rings so every
+            # complete causal chain in the export was minted AFTER the
+            # partition healed
+            obs.trace.reset()
+            time.sleep(run_s / 2)
+            stop.set()
+            for t in producers:
+                t.join(timeout=60)
+            fe.flush()
+            sched.wal.sync()
+            deadline = time.monotonic() + 30
+            while (any(r.published_horizon() != sched._tick
+                       for r in replicas)
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            lag_after = max(r.lag_ticks() for r in replicas)
+            out["lag_after_quiesce_ticks"] = lag_after
+            assert lag_after == 0, f"replicas never converged: {lag_after}"
+
+            # (b) aggregator vs ground truth at quiesce: force fresh
+            # snapshots (twice, spaced, so the qps window exists)
+            for _ in range(2 * n_replicas):
+                tier.top_k(sink.name, 5, by="value")
+            for sh in shippers:
+                sh.ship_once()
+            time.sleep(0.08)
+            for _ in range(2 * n_replicas):
+                tier.top_k(sink.name, 5, by="value")
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if all(sh.ship_once() for sh in shippers):
+                    break
+                time.sleep(0.02)
+            truth = {r.name: r.published_horizon() for r in replicas}
+            snap = agg.fleet_snapshot()
+            agg_h = {n: e["horizon"] for n, e in snap["nodes"].items()
+                     if n != "leader"}
+            assert agg_h == truth, (agg_h, truth)
+            assert all(e["lag_ticks"] == 0
+                       for n, e in snap["nodes"].items()
+                       if n != "leader"), snap["nodes"]
+            spread_truth = max(truth.values()) - min(truth.values())
+            out["lag_spread_agg"] = snap["gauges"]["lag_spread"]
+            out["lag_spread_truth"] = spread_truth
+            assert snap["gauges"]["lag_spread"] == spread_truth
+            assert snap["gauges"]["epoch_agree"] is True
+            out["aggregate_read_qps"] = snap["gauges"][
+                "aggregate_read_qps"]
+            assert out["aggregate_read_qps"] is not None, \
+                "fleet read-qps window never formed"
+            out["fleet_nodes"] = snap["gauges"]["nodes_total"]
+            assert out["fleet_nodes"] == n_replicas + 1
+            log(f"fleetobs: aggregator horizons == ground truth "
+                f"{truth}, spread {spread_truth}, "
+                f"qps {out['aggregate_read_qps']}")
+
+            # (c) causal chains survived the partition/heal cycle
+            trace_path = os.path.join(tmp, "fleet_trace.json")
+            obs.export_chrome_trace(trace_path)
+            spec = importlib.util.spec_from_file_location(
+                "trace_inspect", os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "tools", "trace_inspect.py"))
+            ti = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(ti)
+            causal = ti.inspect(trace_path, require_chain=[
+                "ship_segment", "net_send", "replica_replay"])["causal"]
+            out["post_heal_chains"] = causal["chains"]
+            out["post_heal_complete_chains"] = causal["complete_chains"]
+            out["post_heal_required_chains"] = causal["required_chains"]
+            assert causal["required_chains"] >= 1, \
+                "no post-heal causal chain spans ship->send->replay"
+            keep_trace = env_str("REFLOW_TRACE_OUT",
+                                 "/tmp/reflow_fleet_trace.json")
+            shutil.copyfile(trace_path, keep_trace)
+            out["trace_file"] = keep_trace
+            log(f"fleetobs: {causal['required_chains']} post-heal "
+                f"causal chain(s) ship_segment->net_send->"
+                f"replica_replay -> {keep_trace}")
+
+            # (d) telemetry-link partition: the aggregator keeps
+            # serving with r0 stale-marked, then recovers on heal
+            tele_faults[1].partition("c2s")  # node_regs[1] == r0
+            deadline = time.monotonic() + 15
+            stale = []
+            while time.monotonic() < deadline:
+                stale = agg.stale_nodes()
+                if "r0" in stale:
+                    break
+                time.sleep(0.02)
+            assert "r0" in stale, "telemetry partition never went stale"
+            probe = TelemetryLink(TcpTransport(), tsrv.address,
+                                  node="bench-probe", io_timeout_s=2.0)
+            during = probe.fetch_fleet()
+            assert during is not None, \
+                "aggregator stopped serving during telemetry partition"
+            assert during["nodes"]["r0"]["stale"] is True
+            assert any(a.startswith("stale: r0")
+                       for a in during["alerts"]), during["alerts"]
+            out["stale_during_partition"] = sorted(
+                n for n, e in during["nodes"].items() if e["stale"])
+            r0_shipper = shippers[1]
+            assert r0_shipper.dropped > 0, \
+                "partitioned shipper never dropped a snapshot"
+            out["telemetry_dropped_r0"] = r0_shipper.dropped
+            tele_faults[1].heal()
+            deadline = time.monotonic() + 15
+            while time.monotonic() < deadline:
+                if "r0" not in agg.stale_nodes():
+                    break
+                time.sleep(0.02)
+            after = probe.fetch_fleet()
+            assert after is not None \
+                and after["nodes"]["r0"]["stale"] is False, \
+                "telemetry link never recovered after heal"
+            out["telemetry_partition_recovered"] = True
+            out["snapshots_total"] = agg.snapshots_total
+            fleet_path = "/tmp/reflow_fleet_snapshot.json"
+            with open(fleet_path, "w") as f:
+                json.dump(after, f, indent=2, sort_keys=True)
+            out["fleet_snapshot_file"] = fleet_path
+            log(f"fleetobs: aggregator served through the telemetry "
+                f"partition (stale={out['stale_during_partition']}, "
+                f"{r0_shipper.dropped} dropped) and recovered "
+                f"-> {fleet_path}")
+        finally:
+            stop.set()
+            for t in producers:
+                t.join(timeout=30)
+            if probe is not None:
+                probe.close()
+            for sh in shippers:
+                sh.close()
+            if tsrv is not None:
+                tsrv.close()
+            if agg is not None:
+                agg.close()
+            if fe is not None:
+                fe.close()
+            if ship is not None:
+                ship.close()
+            for srv in servers:
+                srv.close()
+            for r in replicas:
+                r.close()
+            if sched is not None:
+                sched.wal.close()
+            obs.disable()
+            obs.trace.reset()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 # -- tier / multi-graph serving mode (REFLOW_BENCH_TIER=1) -----------------
 
 def run_tier_bench() -> dict:
@@ -3199,10 +3654,15 @@ def _spawn(name: str) -> dict:
             "stdout_tail": lines[-3:]}
 
 
-def _emit(result: dict, json_out=None) -> None:
+def _emit(result: dict, json_out=None, mode: str = None) -> None:
     """Print the final result as the one parseable stdout line; when
     ``--json-out`` was given, also write it there pretty-printed (the
-    machine-comparison artifact — stdout stays the contract)."""
+    machine-comparison artifact — stdout stays the contract). Every
+    result carries the ``reflow.bench/1`` schema stamp plus its bench
+    ``mode`` so directory-level readers (``fleet_inspect
+    --bench-dir``) can classify artifacts without guessing from
+    filenames; pre-stamp files remain readable there by design."""
+    result = {"schema": "reflow.bench/1", "mode": mode, **result}
     print(json.dumps(result))
     if json_out:
         with open(json_out, "w") as f:
@@ -3226,7 +3686,7 @@ def main() -> None:
             "value": out["tier_rows_per_s_4g_2threads"],
             "unit": "rows/s",
             **out,
-        }, json_out)
+        }, json_out, mode="tier")
         return
 
     if env_flag("REFLOW_BENCH_SHARDSERVE"):
@@ -3246,7 +3706,7 @@ def main() -> None:
             "value": out["spread_rows_per_s"],
             "unit": "rows/s",
             **out,
-        }, json_out)
+        }, json_out, mode="shardserve")
         return
 
     if env_flag("REFLOW_BENCH_CONTROL"):
@@ -3258,7 +3718,7 @@ def main() -> None:
             "value": out["quiet_admission_p99_us"],
             "unit": "us",
             **out,
-        }, json_out)
+        }, json_out, mode="control")
         return
 
     if env_flag("REFLOW_BENCH_SERVE"):
@@ -3270,7 +3730,7 @@ def main() -> None:
             "value": out["serve_16p_rows_per_s"],
             "unit": "rows/s",
             **out,
-        }, json_out)
+        }, json_out, mode="serve")
         return
 
     if env_flag("REFLOW_BENCH_WALPIPE"):
@@ -3282,7 +3742,7 @@ def main() -> None:
             "value": out["walpipe_speedup_16p"],
             "unit": "x",
             **out,
-        }, json_out)
+        }, json_out, mode="walpipe")
         return
 
     if env_flag("REFLOW_BENCH_REPLICA"):
@@ -3294,7 +3754,7 @@ def main() -> None:
             "value": out["read_scaling_x"],
             "unit": "x",
             **out,
-        }, json_out)
+        }, json_out, mode="replica")
         return
 
     if env_flag("REFLOW_BENCH_COMPACT"):
@@ -3306,7 +3766,7 @@ def main() -> None:
             "value": out["recover_speedup_x"],
             "unit": "x",
             **out,
-        }, json_out)
+        }, json_out, mode="compact")
         return
 
     if env_flag("REFLOW_BENCH_CHAOS"):
@@ -3318,7 +3778,7 @@ def main() -> None:
             "value": out["converge_s"],
             "unit": "s",
             **out,
-        }, json_out)
+        }, json_out, mode="chaos")
         return
 
     if env_flag("REFLOW_BENCH_FAILOVER"):
@@ -3330,7 +3790,19 @@ def main() -> None:
             "value": out["promotion_s"],
             "unit": "s",
             **out,
-        }, json_out)
+        }, json_out, mode="failover")
+        return
+
+    if env_flag("REFLOW_BENCH_FLEETOBS"):
+        # fleetobs mode is host-side CPU work over local TCP — no tunnel
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        out = run_fleetobs_bench()
+        _emit({
+            "metric": "fleetobs_overhead_frac",
+            "value": out["fleetobs_overhead_frac"],
+            "unit": "frac",
+            **out,
+        }, json_out, mode="fleetobs")
         return
 
     if env_flag("REFLOW_BENCH_OBS"):
@@ -3342,7 +3814,7 @@ def main() -> None:
             "value": out["obs_overhead_frac"],
             "unit": "frac",
             **out,
-        }, json_out)
+        }, json_out, mode="obs")
         return
 
     if env_flag("REFLOW_BENCH_RECOVERY"):
@@ -3355,7 +3827,7 @@ def main() -> None:
             "value": out["time_to_first_tick_s"],
             "unit": "s",
             **out,
-        }, json_out)
+        }, json_out, mode="recovery")
         return
 
     if env_flag("REFLOW_BENCH_PIPELINE"):
@@ -3367,7 +3839,7 @@ def main() -> None:
             "value": out["depth2_vs_depth1_x"],
             "unit": "x",
             **out,
-        }, json_out)
+        }, json_out, mode="pipeline")
         return
 
     if env_flag("REFLOW_BENCH_MEGATICK"):
@@ -3379,7 +3851,7 @@ def main() -> None:
             "value": out["amortized_over_dispatch_x"],
             "unit": "x",
             **out,
-        }, json_out)
+        }, json_out, mode="megatick")
         return
 
     child = env_str("REFLOW_BENCH_CHILD", None)
@@ -3413,7 +3885,7 @@ def main() -> None:
                        "_vs_cpu_executor"),
             "value": 0.0, "unit": "x", "vs_baseline": 0.0,
             "error": tpu["error"],
-        }, json_out)
+        }, json_out, mode="pagerank")
         return
     # the deferred window (cross-tick residual deferral, defer_passes):
     # the incr_vs_full lever, with its accuracy contract measured in the
@@ -3505,7 +3977,7 @@ def main() -> None:
                 tpud.get("drained_max_rel_err"),
             "quiescent_max_rel_err":
                 tpu.get("max_rel_err_vs_reference")} if tpud else {}),
-    }, json_out)
+    }, json_out, mode="pagerank")
 
 
 if __name__ == "__main__":

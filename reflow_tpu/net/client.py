@@ -58,6 +58,10 @@ class RemoteFollower:
         self._conn: Optional[Conn] = None
         self.reconnects_total = 0      # successful re-dials after loss
         self.link_failures = 0
+        #: replica's clock anchor from the last subscribe handshake
+        #: (``obs.wire.clock_anchor`` + rtt_s / wall_offset_s), when the
+        #: server sends one; display-only — never used for ordering
+        self.anchor: Optional[dict] = None
 
     # -- connection state (read by ship.py / read.py / wal_inspect) ----
 
@@ -89,23 +93,37 @@ class RemoteFollower:
         :class:`TransportError` on failure. On return ``self._conn``
         is live and subscribed."""
         conn = self.transport.connect(self.address)
+        t0 = time.monotonic()
         try:
             conn.send_msg(("subscribe",), self.io_timeout_s)
             resp = conn.recv_msg(self.io_timeout_s)
         except TransportError:
             conn.close()
             raise
-        if not (isinstance(resp, tuple) and len(resp) == 2
+        rtt = time.monotonic() - t0
+        if not (isinstance(resp, tuple) and len(resp) >= 2
                 and resp[0] == "ok"):
             conn.close()
             raise TransportError(f"bad subscribe response {resp!r}")
+        if len(resp) >= 3 and isinstance(resp[2], dict):
+            # pre-anchor servers answer a 2-tuple; newer ones piggyback
+            # a clock anchor so trace consumers can display this
+            # replica's monotonic timestamps on the leader's wall axis
+            # (error bounded by rtt/2 — never used for ordering)
+            anchor = dict(resp[2])
+            anchor["rtt_s"] = rtt
+            anchor["wall_offset_s"] = anchor.get("wall", 0.0) - \
+                (time.time() - rtt / 2.0)
+            self.anchor = anchor
         self._conn = conn
         return resp[1] if resp[1] is None else tuple(resp[1])
 
-    def _roundtrip(self, msg: tuple) -> Any:
+    def _roundtrip(self, msg: tuple,
+                   cause: Optional[str] = None) -> Any:
         """One request-response on the live connection. Returns the
         reply, or None on a link failure (connection closed, backoff
-        scheduled)."""
+        scheduled). ``cause`` is echoed into the ``net_send`` span so
+        the hop joins its shipment's cross-process causal chain."""
         conn = self._conn
         if conn is None:
             return None
@@ -116,17 +134,21 @@ class RemoteFollower:
         except TransportError as e:
             self._fail(e)
             if _trace.ENABLED:
+                args = {"op": msg[0], "ok": False,
+                        "error": str(e)[:120],
+                        "state": self.policy.state}
+                if cause is not None:
+                    args["cause"] = cause
                 _trace.evt("net_send", t0, time.perf_counter() - t0,
-                           track=f"net/{self.name}",
-                           args={"op": msg[0], "ok": False,
-                                 "error": str(e)[:120],
-                                 "state": self.policy.state})
+                           track=f"net/{self.name}", args=args)
             return None
         self.policy.ok()
         if _trace.ENABLED:
+            args = {"op": msg[0], "ok": True}
+            if cause is not None:
+                args["cause"] = cause
             _trace.evt("net_send", t0, time.perf_counter() - t0,
-                       track=f"net/{self.name}",
-                       args={"op": msg[0], "ok": True})
+                       track=f"net/{self.name}", args=args)
         return resp
 
     def _reconnect(self) -> Optional[Tuple[Optional[Tuple[int, int]]]]:
@@ -198,7 +220,12 @@ class RemoteFollower:
             # fresh link: hand the shipper the replica's authoritative
             # cursor instead of guessing whether our last chunk landed
             return ShipNack(got[0], "reconnected: resync")
-        resp = self._roundtrip(("receive",) + tuple(sh))
+        fields = tuple(sh)
+        if fields and fields[-1] is None:
+            # unstamped shipment: drop the trailing None cause so the
+            # wire frame stays byte-identical to the pre-trace protocol
+            fields = fields[:-1]
+        resp = self._roundtrip(("receive",) + fields, cause=sh.cause)
         if resp is None:
             return None
         if isinstance(resp, tuple) and resp and resp[0] == "ack":

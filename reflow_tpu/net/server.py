@@ -10,7 +10,7 @@ wire adds nothing but the wire.
 
 Requests (pickled tuples, ``net/framing.py``)::
 
-    ("subscribe",)                     -> ("ok", cursor | None)
+    ("subscribe",)                     -> ("ok", cursor | None, anchor)
     ("bootstrap", ckpt_dir)            -> ("ok", cursor)
     ("receive", *shipment_fields)      -> ("ack", cursor, horizon)
                                         | ("nack", cursor, reason)
@@ -143,7 +143,13 @@ class ReplicaServer:
         r = self.replica
         if op == "subscribe":
             cur = r.subscribe()
-            return ("ok", tuple(cur) if cur is not None else None)
+            # piggyback a clock anchor on the handshake so the leader
+            # can display this replica's span timestamps on one wall
+            # axis; old clients ignore the third element (lazy import —
+            # obs.wire rides this package's transports)
+            from reflow_tpu.obs.wire import clock_anchor
+            return ("ok", tuple(cur) if cur is not None else None,
+                    clock_anchor(getattr(r, "name", "replica")))
         if op == "bootstrap":
             return ("ok", tuple(r.bootstrap(args[0])))
         if op == "receive":

@@ -27,17 +27,31 @@ __all__ = ["chrome_events", "export_chrome_trace", "ticket_timelines"]
 
 def chrome_events() -> List[Dict[str, Any]]:
     """Snapshot all rings into a chrome trace-event list (metadata
-    events first, then ``"X"`` spans). Empty when nothing was traced."""
+    events first, then ``"X"`` spans). Empty when nothing was traced.
+
+    A ring that wrapped has silently overwritten its oldest events —
+    which can truncate a causal chain mid-window — so each wrapped
+    ring's track carries a ``dropped_events`` metadata event with the
+    exact overwrite count (``Ring.n`` counts every put ever, so drops
+    are ``n - cap``); readers must treat such tracks as incomplete
+    rather than assuming the window starts at the first surviving
+    event."""
     with trace._rings_lock:
         rings = list(trace._rings)
     raw = []
+    dropped: Dict[str, int] = {}
     for r in rings:
+        n = r.n
+        if n > r.cap:
+            dropped[r.track] = dropped.get(r.track, 0) + (n - r.cap)
         for ev in r.events():
             raw.append((r.track, ev))
     if not raw:
         return []
     base = min(ev[1] for _t, ev in raw)
     tids: Dict[str, int] = {}
+    for t in dropped:
+        tids[t] = len(tids) + 1
     spans = []
     for ring_track, (name, ts, dur, track, args) in raw:
         t = track or ring_track
@@ -56,6 +70,10 @@ def chrome_events() -> List[Dict[str, Any]]:
     for t, tid in sorted(tids.items(), key=lambda kv: kv[1]):
         meta.append({"ph": "M", "name": "thread_name", "pid": 1,
                      "tid": tid, "args": {"name": t}})
+    for t, count in sorted(dropped.items()):
+        meta.append({"ph": "M", "name": "dropped_events", "pid": 1,
+                     "tid": tids[t], "args": {"track": t,
+                                              "count": count}})
     return meta + spans
 
 

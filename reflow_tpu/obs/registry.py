@@ -193,11 +193,14 @@ class SnapshotEmitter:
     ``interval_s`` seconds (plus a final one at :meth:`stop`)."""
 
     def __init__(self, path: str, *, interval_s: float = 5.0,
-                 registry: Optional[MetricsRegistry] = None):
+                 registry: Optional[MetricsRegistry] = None,
+                 clock: Callable[[], float] = time.monotonic):
         self.path = path
         self.interval_s = interval_s
         self.registry = registry if registry is not None else REGISTRY
         self.lines = 0
+        self._clock = clock
+        self._deadline: Optional[float] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._f = None
@@ -212,9 +215,26 @@ class SnapshotEmitter:
         self._thread.start()
         return self
 
+    def _sleep_s(self) -> float:
+        """Time left until the armed deadline — shrinks by however long
+        the last emit took, so cadence does not drift with emit cost."""
+        return max(0.0, self._deadline - self._clock())
+
+    def _rearm(self) -> None:
+        """Advance the deadline one interval from the *previous*
+        deadline (fixed-rate), not from now (fixed-delay — the drift
+        bug). If an emit overran a whole interval, snap forward instead
+        of burst-emitting to catch up."""
+        self._deadline += self.interval_s
+        now = self._clock()
+        if self._deadline <= now:
+            self._deadline = now + self.interval_s
+
     def _loop(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        self._deadline = self._clock() + self.interval_s
+        while not self._stop.wait(self._sleep_s()):
             self._emit()
+            self._rearm()
 
     def _emit(self) -> None:
         snap = {"schema": SNAPSHOT_SCHEMA, "ts": time.time(),

@@ -43,8 +43,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["ENABLED", "RING_CAPACITY", "SAMPLE_EVERY", "STAGES",
            "TraceCtx", "enable", "disable", "enabled", "reset", "evt",
-           "mint", "ticket_stages", "wal_accum_reset", "wal_accum_add",
-           "wal_accum_take"]
+           "mint", "mint_cause", "ticket_stages", "wal_accum_reset",
+           "wal_accum_add", "wal_accum_take"]
 
 #: hot-path gate — read directly (``if trace.ENABLED:``) at every
 #: instrumentation site; never wrapped in a function call
@@ -69,17 +69,26 @@ _rings_lock = named_lock("obs.trace.rings")  # ring *registration* only, never p
 _tls = threading.local()
 _gen = 0
 _mint_n = itertools.count()
+_cause_n = itertools.count()
 
 
 class TraceCtx:
-    """Per-submission trace context carried on the Ticket."""
+    """Per-submission trace context carried on the Ticket.
 
-    __slots__ = ("batch_id", "t0", "sampled")
+    ``cause`` is the optional causality token (:func:`mint_cause`) that
+    correlates this context with spans recorded in *other processes* —
+    the replication path stamps it onto :class:`~reflow_tpu.wal.ship.
+    Shipment` frames so ``ship_segment`` → ``net_send`` →
+    ``replica_replay`` stitch into one cross-process chain."""
 
-    def __init__(self, batch_id: str, t0: float, sampled: bool):
+    __slots__ = ("batch_id", "t0", "sampled", "cause")
+
+    def __init__(self, batch_id: str, t0: float, sampled: bool,
+                 cause: Optional[str] = None):
         self.batch_id = batch_id
         self.t0 = t0
         self.sampled = sampled
+        self.cause = cause
 
 
 class Ring:
@@ -157,6 +166,18 @@ def mint(batch_id: str, t0: float) -> TraceCtx:
     """Mint the trace context for one submission (call under ENABLED)."""
     return TraceCtx(batch_id, t0,
                     next(_mint_n) % SAMPLE_EVERY == 0)
+
+
+def mint_cause(origin: str, epoch: int) -> str:
+    """Mint one causality token: ``<origin>#<epoch>#<seq>``.
+
+    ``origin`` is the minting node's fleet id, ``epoch`` the WAL epoch
+    the work belongs to, ``seq`` a process-local monotonic counter.
+    The token is an opaque string on purpose: it rides span ``args``
+    (JSON) and the pickled ``Shipment`` wire frame unchanged, and every
+    process that re-records it under its own clock still joins on exact
+    string equality — no cross-host clock trust required."""
+    return f"{origin}#{epoch}#{next(_cause_n)}"
 
 
 def ticket_stages(ctx: TraceCtx, *, t_adm: float, t_ready: float,

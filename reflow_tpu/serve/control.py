@@ -487,7 +487,7 @@ class ControlPlane:
                  clock: Callable[[], float] = time.monotonic,
                  rng: Optional[Callable[[], float]] = None,
                  sampler: Optional[Callable[[float], Dict]] = None,
-                 failover=None, compactor=None):
+                 failover=None, compactor=None, fleet=None):
         from reflow_tpu.obs import REGISTRY
         self.tier = tier
         #: optional serve.failover.FailoverCoordinator, stepped on the
@@ -497,6 +497,13 @@ class ControlPlane:
         #: optional wal.compact.WalCompactor, supervised on the control
         #: interval with the committer's respawn-or-fail-fast budget
         self.compactor = compactor
+        #: optional obs.fleet.FleetAggregator: fleet gauges consulted
+        #: on the control interval. Advisory only — a lag-spread breach
+        #: is surfaced as an action + counter, never actuated, because
+        #: telemetry is allowed to be stale or absent (the inversion
+        #: the fleet plane is built on)
+        self.fleet = fleet
+        self._fleet_breached = False
         self._compactor_restarts_used = 0
         self._compactor_failed = False
         self._compactor_booted = False
@@ -535,7 +542,8 @@ class ControlPlane:
             "breaker_probes", "breaker_closes", "worker_respawns",
             "committer_restarts", "scale_ups", "scale_downs",
             "reclaims", "floor_restores", "errors",
-            "compactions", "compactor_restarts")}
+            "compactions", "compactor_restarts",
+            "fleet_lag_breaches")}
         reg.gauge("pool.live_workers", lambda: self.tier.live_workers)
         reg.gauge("control.interval_s", lambda: self.config.interval_s)
 
@@ -623,9 +631,38 @@ class ControlPlane:
             actions.extend(self.failover.step(now))
         if self.compactor is not None:
             self._step_compactor(now, actions)
+        if self.fleet is not None:
+            self._step_fleet(now, actions)
         for a in actions:
             self._record(a)
         return actions
+
+    def _step_fleet(self, now: float, actions: List[Dict]) -> None:
+        """Consult the fleet aggregator's cross-node gauges. A lag
+        spread past the aggregator's threshold raises an *advisory*
+        action, edge-triggered (one per breach episode, one more on
+        recovery) — the operator decides; this loop never actuates on
+        telemetry that is allowed to be stale."""
+        try:
+            snap = self.fleet.fleet_snapshot()
+        except Exception:  # noqa: BLE001 - telemetry loss is tolerated
+            return
+        gauges = snap.get("gauges", {})
+        spread = gauges.get("lag_spread")
+        limit = getattr(self.fleet, "lag_spread_max", None)
+        breached = (spread is not None and limit is not None
+                    and spread > limit)
+        if breached and not self._fleet_breached:
+            self._c["fleet_lag_breaches"].inc()
+            actions.append({"now": now, "kind": "fleet_lag_spread",
+                            "advisory": True,
+                            "lag_spread": spread, "limit": limit,
+                            "stale_nodes": gauges.get("nodes_stale", 0),
+                            "alerts": list(snap.get("alerts", []))})
+        elif self._fleet_breached and not breached:
+            actions.append({"now": now, "kind": "fleet_lag_recovered",
+                            "advisory": True, "lag_spread": spread})
+        self._fleet_breached = breached
 
     def _step_compactor(self, now: float, actions: List[Dict]) -> None:
         """Supervise the background WAL compactor: surface completed

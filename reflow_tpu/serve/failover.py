@@ -155,7 +155,7 @@ class FailoverCoordinator:
         self.promotions = 0
         self.drained_bytes = 0
         self.partitions_detected = 0
-        self._metric_names: List[str] = []
+        self._metric_names: List[tuple] = []
 
     # -- detection ---------------------------------------------------------
 
@@ -381,12 +381,13 @@ class FailoverCoordinator:
         reg.gauge("fence.rejected_shipments",
                   lambda: sum(getattr(r, "fence_rejected_shipments", 0)
                               for r in self.replicas))
-        self._metric_names += ["failover.", "leader.heartbeat_age_s",
-                               "fence."]
+        self._metric_names += [(reg, "failover."),
+                               (reg, "leader.heartbeat_age_s"),
+                               (reg, "fence.")]
 
     def close(self) -> None:
         if self.new_shipper is not None:
             self.new_shipper.stop()
-        for base in self._metric_names:
-            REGISTRY.unregister_prefix(base)
+        for reg, base in self._metric_names:
+            reg.unregister_prefix(base)
         self._metric_names.clear()

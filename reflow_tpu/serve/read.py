@@ -129,7 +129,7 @@ class ReadTier:
         self._links: Dict[int, object] = {}
         self.ejects = 0
         self.restores = 0
-        self._metric_names: List[str] = []
+        self._metric_names: List[Tuple[object, str]] = []
 
     # -- membership --------------------------------------------------------
 
@@ -293,11 +293,10 @@ class ReadTier:
         reg.gauge(f"{base}.ejects", lambda: self.ejects)
         reg.gauge(f"{base}.restores", lambda: self.restores)
         reg.gauge("replica.lag_ticks", self.max_lag_ticks)
-        self._metric_names.append(base)
+        self._metric_names.append((reg, base))
+        self._metric_names.append((reg, "replica.lag_ticks"))
 
     def close(self) -> None:
-        for base in self._metric_names:
-            REGISTRY.unregister_prefix(base)
-        if self._metric_names:
-            REGISTRY.unregister_prefix("replica.lag_ticks")
+        for reg, base in self._metric_names:
+            reg.unregister_prefix(base)
         self._metric_names.clear()

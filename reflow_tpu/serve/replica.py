@@ -125,7 +125,7 @@ class ReplicaScheduler:
         self.fence_rejected_shipments = 0
         self.bootstraps = 0
         self.restored_from: Optional[str] = None
-        self._metric_names: List[str] = []
+        self._metric_names: List[Tuple[object, str]] = []
         self._restore()
 
     # -- transport surface (the watermark handshake) -----------------------
@@ -226,6 +226,7 @@ class ReplicaScheduler:
                        args={"segment": sh.segment, "bytes": len(sh.payload),
                              "records": len(entries), "applied": applied,
                              "horizon": ack.horizon,
+                             "cause": getattr(sh, "cause", None),
                              "lag_ticks": self.lag_ticks()})
         return ack
 
@@ -554,9 +555,9 @@ class ReplicaScheduler:
         reg.gauge(f"{base}.epoch", lambda: self._epoch)
         reg.gauge(f"{base}.fence_rejected_shipments",
                   lambda: self.fence_rejected_shipments)
-        self._metric_names.append(base)
+        self._metric_names.append((reg, base))
 
     def close(self) -> None:
-        for base in self._metric_names:
-            REGISTRY.unregister_prefix(base)
+        for reg, base in self._metric_names:
+            reg.unregister_prefix(base)
         self._metric_names.clear()

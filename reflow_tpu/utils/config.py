@@ -297,6 +297,30 @@ declare("REFLOW_BENCH_COMPACT_TICKS", "int", None,
         "compact bench batches per producer per leg "
         "(default 480, smoke 160)")
 
+# -- fleet telemetry (docs/guide.md 'Fleet telemetry') ----------------------
+
+declare("REFLOW_FLEET_NODE", "str", None,
+        "this process's node id on the telemetry plane "
+        "(default node-<pid>)")
+declare("REFLOW_FLEET_INTERVAL_S", "float", 0.25,
+        "telemetry shipper beat: seconds between registry-snapshot "
+        "pushes to the fleet aggregator")
+declare("REFLOW_FLEET_RETENTION", "int", 256,
+        "fleet aggregator per-node time-series ring length "
+        "(snapshots kept)")
+declare("REFLOW_FLEET_STALE_S", "float", 2.0,
+        "aggregator stale-marks a node whose newest snapshot is older "
+        "than this (telemetry-loss display, never an error)")
+declare("REFLOW_FLEET_LAG_SPREAD_MAX", "int", 64,
+        "fleet lag-spread gauge (max-min follower horizon, ticks) "
+        "above which the control plane logs an advisory action")
+declare("REFLOW_BENCH_FLEETOBS", "flag", False,
+        "bench mode: fleet telemetry plane — overhead A/B + causal "
+        "chains + stale-marking on the chaos topology")
+declare("REFLOW_BENCH_FLEETOBS_BATCHES", "int", None,
+        "fleetobs bench batches per producer per A/B leg "
+        "(default 320, smoke 160)")
+
 
 # -- the config dataclass ---------------------------------------------------
 

@@ -78,7 +78,12 @@ class Shipment(NamedTuple):
     gauge without a second channel. ``epoch`` is the shipping leader's
     epoch token (``wal/log.py`` fencing): a receiver rejects shipments
     from an epoch below its own — a fenced zombie's bytes are never
-    merged. Defaulted so pre-epoch constructors stay valid."""
+    merged. ``cause`` is an opaque causality token
+    (``obs.trace.mint_cause``) stamped only while tracing is enabled so
+    the ship → send → replay spans of one chunk stitch into a single
+    cross-process chain; receivers echo it into their replay span and
+    otherwise ignore it. Both trailing fields are defaulted so
+    pre-epoch / pre-trace constructors stay valid."""
 
     segment: int
     offset: int
@@ -88,6 +93,7 @@ class Shipment(NamedTuple):
     next_segment: Optional[int]
     leader_tick: int
     epoch: int = 0
+    cause: Optional[str] = None
 
 
 class ShipAck(NamedTuple):
@@ -236,7 +242,10 @@ class SegmentShipper:
         #: manifest so the hot shipping path stats instead of parsing
         self._compact_cache: Tuple[Optional[int], Dict[int, dict]] = \
             (None, {})
-        self._metric_names: List[str] = []
+        #: (registry, prefix) pairs, unregistered from the *same*
+        #: registry they were registered on (a bare prefix list silently
+        #: leaked gauges on any non-global registry)
+        self._metric_names: List[Tuple[object, str]] = []
         self._metrics_registry = None
 
     @property
@@ -401,8 +410,16 @@ class SegmentShipper:
             chunk_end = cur.offset + valid
         seals = sealed and chunk_end == end
         nxt = self._next_segment(segs, cur.segment) if seals else None
+        tok: Optional[str] = None
+        if _trace.ENABLED:
+            # stamp a causality token so this chunk's ship_segment /
+            # net_send / replica_replay spans stitch across processes;
+            # lazy import — obs.wire rides net/, which rides this module
+            from reflow_tpu.obs.wire import node_id as _node_id
+            tok = _trace.mint_cause(_node_id(), self.epoch)
         shipment = Shipment(cur.segment, cur.offset, payload, chunk_end,
-                            seals, nxt, self._leader_tick(), self.epoch)
+                            seals, nxt, self._leader_tick(), self.epoch,
+                            tok)
         if payload and st.high_water is not None and cur < st.high_water:
             # re-offering bytes the follower was already sent: the WAL
             # acting as the retransmit buffer, made visible
@@ -421,6 +438,7 @@ class SegmentShipper:
                              "offset": cur.offset,
                              "bytes": len(payload),
                              "seals": seals,
+                             "cause": tok,
                              "ack": isinstance(resp, ShipAck)})
         if resp is None:
             # link-level no-progress (remote follower down or inside a
@@ -602,8 +620,8 @@ class SegmentShipper:
 
     def close(self) -> None:
         self.stop()
-        for name in self._metric_names:
-            REGISTRY.unregister_prefix(name)
+        for reg, name in self._metric_names:
+            reg.unregister_prefix(name)
         self._metric_names.clear()
 
     # -- observability -----------------------------------------------------
@@ -634,8 +652,8 @@ class SegmentShipper:
                   lambda: self.compact_reanchors)
         reg.gauge("net.reconnects_total", self._net_reconnects_total)
         reg.gauge("net.retransmit_bytes", lambda: self.retransmit_bytes)
-        self._metric_names.append(name)
-        self._metric_names.append("net.")
+        self._metric_names.append((reg, name))
+        self._metric_names.append((reg, "net."))
         with self._lock:
             states = list(self._followers.values())
         for st in states:
@@ -645,4 +663,4 @@ class SegmentShipper:
     def _publish_conn_state(self, reg, follower_name: str) -> None:
         gname = f"replica.{follower_name}.conn_state"
         reg.gauge(gname, lambda n=follower_name: self._conn_state(n))
-        self._metric_names.append(gname)
+        self._metric_names.append((reg, gname))

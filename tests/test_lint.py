@@ -217,6 +217,69 @@ def test_metrics_name_grammar(tmp_path):
     assert ok["findings"] == []
 
 
+def test_metrics_registry_mismatch_trips_and_paired_release_cleans(
+        tmp_path):
+    """Registering into a caller-supplied registry while releasing only
+    through the global REGISTRY satisfies the pairing rule but leaks
+    every gauge on a private registry — the pre-fleet close-path bug."""
+    bad = _lint(tmp_path / "a", {"reflow_tpu/m.py": (
+        "from reflow_tpu.obs import REGISTRY\n"
+        "class C:\n"
+        "    def publish(self, reg):\n"
+        "        reg.gauge('c.depth', lambda: 1)\n"
+        "    def close(self):\n"
+        "        REGISTRY.unregister_prefix('c.')\n")},
+        passes=["metrics"])
+    assert _rules(bad) == ["metrics-registry-mismatch"]
+    assert "(registry, name)" in bad["findings"][0]["msg"]
+    ok = _lint(tmp_path / "b", {"reflow_tpu/m.py": (
+        "class C:\n"
+        "    def publish(self, reg):\n"
+        "        reg.gauge('c.depth', lambda: 1)\n"
+        "        self._pairs = [(reg, 'c.')]\n"
+        "    def close(self):\n"
+        "        for reg, name in self._pairs:\n"
+        "            reg.unregister_prefix(name)\n")},
+        passes=["metrics"])
+    assert ok["findings"] == []
+    # global-only registrations released globally are the old (fine)
+    # convention, not a mismatch
+    ok2 = _lint(tmp_path / "c", {"reflow_tpu/m.py": (
+        "from reflow_tpu.obs import REGISTRY\n"
+        "def publish():\n"
+        "    REGISTRY.gauge('c.depth', lambda: 1)\n"
+        "def close():\n"
+        "    REGISTRY.unregister_prefix('c.')\n")},
+        passes=["metrics"])
+    assert ok2["findings"] == []
+
+
+def test_metrics_source_unreleased_is_corpus_wide(tmp_path):
+    """register_source coverage crosses both the reflow_tpu/ boundary
+    (a bench helper's source counts) and file boundaries (a release
+    literal elsewhere in the corpus covers it)."""
+    src = ("def hook(reg):\n"
+           "    reg.register_source('orphan.src', lambda: {})\n")
+    bad = _lint(tmp_path / "a", {"bench_helper.py": src},
+                passes=["metrics"])
+    assert _rules(bad) == ["metrics-source-unreleased"]
+    assert bad["findings"][0]["path"] == "bench_helper.py"
+    # a covering unregister literal in ANOTHER file is a release
+    ok = _lint(tmp_path / "b", {
+        "bench_helper.py": src,
+        "reflow_tpu/sealer.py": (
+            "def seal(reg):\n"
+            "    reg.unregister_prefix('orphan.')\n")},
+        passes=["metrics"])
+    assert ok["findings"] == []
+    # a release in the same file is the normal convention
+    ok2 = _lint(tmp_path / "c", {"bench_helper.py": src + (
+        "def unhook(reg):\n"
+        "    reg.unregister_source('orphan.src')\n")},
+        passes=["metrics"])
+    assert ok2["findings"] == []
+
+
 # -- env-knob rules ---------------------------------------------------------
 
 def test_env_knob_direct_read_trips(tmp_path):

@@ -228,6 +228,69 @@ def test_snapshot_emitter_writes_schema_lines(tmp_path):
     assert all("ts" in r for r in rows)
 
 
+def test_snapshot_emitter_fixed_rate_rearm_does_not_drift(tmp_path):
+    """The interval-drift regression: re-arming from *now* (fixed
+    delay) would push every deadline late by the emit cost; the fix
+    re-arms from the previous deadline, so a slow emit shrinks the next
+    sleep instead of shifting the cadence."""
+    clk = {"t": 0.0}
+    em = obs.SnapshotEmitter(str(tmp_path / "t.jsonl"), interval_s=1.0,
+                             registry=obs.MetricsRegistry(),
+                             clock=lambda: clk["t"])
+    em._deadline = 1.0  # as armed at loop entry with the clock at 0
+    clk["t"] = 1.4      # the emit burned 0.4s past the deadline
+    em._rearm()
+    assert em._deadline == pytest.approx(2.0)  # fixed-delay bug: 2.4
+    assert em._sleep_s() == pytest.approx(0.6)
+    # an emit that overran a whole interval snaps forward — one beat
+    # is skipped rather than burst-emitted to catch up
+    clk["t"] = 4.3
+    em._rearm()
+    assert em._deadline == pytest.approx(5.3)
+    assert em._sleep_s() == pytest.approx(1.0)
+
+
+def test_ring_overflow_export_carries_drop_marker(monkeypatch):
+    """A wrapped ring has silently overwritten its oldest spans — the
+    export must say so (per-track ``dropped_events`` metadata with the
+    exact overwrite count) instead of letting readers assume the window
+    starts at the first surviving event."""
+    obs.disable()
+    trace_mod.reset()
+    monkeypatch.setattr(trace_mod, "RING_CAPACITY", 8)
+    obs.enable()
+    try:
+        for i in range(13):
+            trace_mod.evt(f"e{i}", float(i), 1.0)
+        evs = obs.chrome_events()
+        drops = [e for e in evs if e.get("ph") == "M"
+                 and e["name"] == "dropped_events"]
+        assert len(drops) == 1
+        assert drops[0]["args"]["count"] == 5  # 13 puts - 8 capacity
+        tracks = {e["tid"]: e["args"]["name"] for e in evs
+                  if e.get("ph") == "M" and e["name"] == "thread_name"}
+        assert drops[0]["args"]["track"] == tracks[drops[0]["tid"]]
+    finally:
+        obs.disable()
+        trace_mod.reset()
+
+
+def test_unwrapped_ring_has_no_drop_marker(monkeypatch):
+    obs.disable()
+    trace_mod.reset()
+    monkeypatch.setattr(trace_mod, "RING_CAPACITY", 8)
+    obs.enable()
+    try:
+        for i in range(8):  # exactly full: nothing overwritten
+            trace_mod.evt(f"e{i}", float(i), 1.0)
+        evs = obs.chrome_events()
+        assert not any(e.get("name") == "dropped_events" for e in evs)
+        assert len([e for e in evs if e.get("ph") == "X"]) == 8
+    finally:
+        obs.disable()
+        trace_mod.reset()
+
+
 def test_frontend_publish_unregisters_on_close():
     reg = obs.MetricsRegistry()
     g, src, _sink = wordcount.build_graph()

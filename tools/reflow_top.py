@@ -1,0 +1,140 @@
+#!/usr/bin/env python3
+"""reflow-top: live terminal view of the replicated tier's fleet.
+
+Usage::
+
+    python tools/reflow_top.py --connect HOST:PORT          # live, 1s refresh
+    python tools/reflow_top.py --connect HOST:PORT --once   # one frame
+    python tools/reflow_top.py fleet.json --once            # saved snapshot
+
+Each refresh fetches one ``reflow.fleet/1`` snapshot from the
+:class:`~reflow_tpu.obs.wire.TelemetryServer` and redraws: one row per
+node (replication horizon, lag ticks, read QPS, epoch, link states,
+snapshot age), the fleet gauges line (lag spread, epoch agreement,
+aggregate QPS, compaction debt), brownout levels where a node reports
+them, and the aggregator's alert lines. A node whose telemetry went
+quiet is shown ``STALE`` with its age — the fleet view keeps serving
+last-known state through a telemetry partition, and so does this
+console: when a fetch fails it redraws the previous frame marked
+``[disconnected]`` instead of exiting. Ctrl-C quits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_CLEAR = "\x1b[2J\x1b[H"
+
+
+def render(snap: dict, *, stale_link: bool = False) -> str:
+    """One frame of the console as a string (testable without a TTY)."""
+    g = snap.get("gauges", {})
+    nodes = snap.get("nodes", {})
+    lines = []
+    flag = " [disconnected]" if stale_link else ""
+    lines.append(f"reflow-top — {g.get('nodes_total', 0)} node(s), "
+                 f"{g.get('nodes_stale', 0)} stale, "
+                 f"{g.get('snapshots_total', 0)} snapshot(s){flag}")
+    spread = g.get("lag_spread")
+    qps = g.get("aggregate_read_qps")
+    debt = g.get("compact_debt_bytes")
+    lines.append(
+        f"lag spread {('n/a' if spread is None else int(spread))} "
+        f"tick(s) | epochs {g.get('epochs')} "
+        f"{'agree' if g.get('epoch_agree') else 'DISAGREE'} | "
+        f"read qps {('n/a' if qps is None else qps)} | "
+        f"compact debt {('n/a' if debt is None else int(debt))} B")
+    lines.append(f"{'NODE':<16} {'HORIZON':>8} {'LAG':>5} {'QPS':>8} "
+                 f"{'EPOCH':>6} {'AGE':>7} LINKS")
+    for name, e in sorted(nodes.items()):
+        states = e.get("conn_states", {})
+        conn = ",".join(f"{k.rsplit('.', 2)[-2]}={v}"
+                        for k, v in sorted(states.items())) or "-"
+        if e.get("stale"):
+            conn = f"STALE({e.get('age_s', 0):.1f}s) {conn}"
+        nqps = e.get("read_qps")
+        hor = e.get("horizon")
+        lag = e.get("lag_ticks")
+        ep = e.get("epoch")
+        lines.append(
+            f"{name:<16} "
+            f"{int(hor) if hor is not None else '-':>8} "
+            f"{int(lag) if lag is not None else '-':>5} "
+            f"{f'{nqps:.1f}' if nqps is not None else '-':>8} "
+            f"{int(ep) if ep is not None else '-':>6} "
+            f"{e.get('age_s', 0):>6.1f}s {conn}")
+        brown = e.get("brownout")
+        if brown:
+            levels = ", ".join(f"{k}={v}" for k, v in sorted(brown.items()))
+            lines.append(f"{'':<16} brownout: {levels}")
+    for line in snap.get("alerts", []):
+        lines.append(f"ALERT: {line}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("snapshot", nargs="?",
+                    help="saved reflow.fleet/1 JSON (for --once)")
+    ap.add_argument("--connect", metavar="HOST:PORT",
+                    help="dial a live TelemetryServer")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="refresh period in seconds (default 1.0)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (no clear-screen)")
+    args = ap.parse_args(argv)
+    if not args.connect and not args.snapshot:
+        ap.error("need --connect or a snapshot file")
+        return 2
+
+    link = None
+    if args.connect:
+        from reflow_tpu.net.transport import TcpTransport
+        from reflow_tpu.obs.wire import TelemetryLink
+        host, _, port = args.connect.rpartition(":")
+        host = host or "127.0.0.1"
+        link = TelemetryLink(TcpTransport(host), (host, int(port)),
+                             node="reflow-top", io_timeout_s=2.0)
+
+    def fetch():
+        if link is not None:
+            return link.fetch_fleet()
+        with open(args.snapshot) as f:
+            return json.load(f)
+
+    last = None
+    try:
+        while True:
+            snap = fetch()
+            stale_link = snap is None
+            if snap is None:
+                snap = last
+            if snap is None:
+                print("reflow-top: aggregator unreachable, retrying...",
+                      file=sys.stderr)
+            else:
+                last = snap
+                frame = render(snap, stale_link=stale_link)
+                if args.once:
+                    print(frame)
+                    return 0
+                sys.stdout.write(_CLEAR + frame + "\n")
+                sys.stdout.flush()
+            if args.once:
+                return 1  # --once with nothing to render
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if link is not None:
+            link.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

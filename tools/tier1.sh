@@ -346,4 +346,60 @@ print(f"TIER1 compact smoke: history {r['history_ratio']}x state — "
       f"{r['wal_bounded_bytes']}/{r['wal_full_bytes']} bytes")
 EOF
 fi
+
+# optional (RUN_BENCH=1): the fleetobs smoke — the fleet telemetry
+# plane on the replicated TCP topology: aggregator horizons must EQUAL
+# ground truth at quiesce, at least one post-heal causal chain must
+# span ship_segment->net_send->replica_replay (re-checked through
+# trace_inspect --require-chain), the aggregator must keep serving
+# stale-marked through a telemetry-link partition and recover, the
+# saved fleet snapshot must round-trip through fleet_inspect as
+# reflow.fleet/1, and every bench JSON this run produced must carry
+# the reflow.bench/1 stamp (fleet_inspect --bench-dir). The <3%
+# overhead acceptance holds on an uncontended host; shared CI cores
+# make wall ratios noise, so the smoke takes a generous sanity ceiling
+# and prints the measured number.
+if [ "${RUN_BENCH:-0}" = "1" ] && [ $rc -eq 0 ]; then
+  REFLOW_BENCH_FLEETOBS=1 REFLOW_BENCH_SMOKE=1 JAX_PLATFORMS=cpu \
+    REFLOW_TRACE_OUT=/tmp/_t1_fleet_trace.json \
+    timeout -k 10 590 python bench.py --json-out /tmp/_t1_fleetobs.json \
+    > /dev/null || rc=3
+  python - <<'EOF' || rc=3
+import json
+r = json.load(open("/tmp/_t1_fleetobs.json"))
+assert r["schema"] == "reflow.bench/1" and r["mode"] == "fleetobs", r
+assert r["lag_spread_agg"] == r["lag_spread_truth"], r
+assert r["lag_after_quiesce_ticks"] == 0, r
+assert r["post_heal_required_chains"] >= 1, r
+assert r["stale_during_partition"] == ["r0"], r
+assert r["telemetry_partition_recovered"], r
+assert r["fleet_nodes"] == r["replicas"] + 1, r
+assert r["fleetobs_overhead_frac"] < 0.5, r
+print(f"TIER1 fleetobs smoke: {r['fleet_nodes']} nodes, lag spread "
+      f"{r['lag_spread_agg']} == truth, "
+      f"{r['post_heal_required_chains']} post-heal causal chain(s), "
+      f"served stale-marked through telemetry partition "
+      f"({r['telemetry_dropped_r0']} dropped), overhead "
+      f"{100 * r['fleetobs_overhead_frac']:.2f}%")
+EOF
+  python tools/trace_inspect.py /tmp/_t1_fleet_trace.json \
+    --require-chain ship_segment,net_send,replica_replay > /dev/null \
+    || { echo "TIER1: fleetobs require-chain failed"; rc=3; }
+  python tools/fleet_inspect.py /tmp/reflow_fleet_snapshot.json --json \
+    > /tmp/_t1_fleet_snap.json \
+    || { echo "TIER1: fleet_inspect snapshot failed"; rc=3; }
+  python - <<'EOF' || rc=3
+import json
+s = json.load(open("/tmp/_t1_fleet_snap.json"))
+assert s["schema"] == "reflow.fleet/1", s
+assert s["gauges"]["nodes_total"] >= 4 and not s["alerts"], s
+d = json.load(__import__("os").popen(
+    "python tools/fleet_inspect.py --bench-dir /tmp --json"))
+assert d["schema"] == "reflow.fleet_benchdir/1", d
+assert any(e["mode"] == "fleetobs" for e in d["benches"]), d
+print(f"TIER1 fleetobs consumers: fleet/1 snapshot ok "
+      f"({s['gauges']['nodes_total']} nodes, 0 alerts), bench dir "
+      f"{d['stamped']} stamped / {d['unstamped']} pre-stamp")
+EOF
+fi
 exit $rc

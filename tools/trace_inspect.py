@@ -35,6 +35,16 @@ tracks; the report folds them into a **replication** section — per
 follower byte flow and NACKs, per replica applied records, replay time,
 and the published-horizon lag after each window.
 
+A trace whose replication spans carry **causality tokens**
+(``obs.trace.mint_cause`` stamped onto shipments while tracing is on)
+gets a **causal chains** section: spans sharing one ``args.cause``
+token are stitched into a single cross-process chain
+(``ship_segment`` → ``net_send`` → ``replica_replay``), with per-link
+latency attribution over the complete chains — the end-to-end
+replication critical path, hop by hop. ``--require-chain a,b,c``
+makes the exit status assert that at least one chain carries all the
+named spans (the fleet bench's smoke check).
+
 A trace recorded across a **leader failover** (``serve/failover.py``)
 carries ``failover_elect`` / ``failover_replay`` spans on the
 ``failover`` and replica tracks and ``fence_reject`` spans wherever a
@@ -73,8 +83,12 @@ def load_events(path: str) -> list:
     return raw["traceEvents"] if isinstance(raw, dict) else raw
 
 
-def inspect(path: str) -> dict:
-    """Summarize one trace file; the dict is the ``--json`` output."""
+def inspect(path: str, require_chain=None) -> dict:
+    """Summarize one trace file; the dict is the ``--json`` output.
+    ``require_chain`` (a list of span names) additionally reports, as
+    ``causal.required_chains``, how many causal chains carry *all* of
+    the named spans — the assertable form of "the end-to-end path
+    survived"."""
     events = load_events(path)
     by_name: dict = defaultdict(list)
     tracks = set()
@@ -117,10 +131,24 @@ def inspect(path: str) -> dict:
                  "ops": defaultdict(int), "reconnect_attempts": 0,
                  "reconnects": 0, "reconnect_ms": 0.0,
                  "last_state": None})
+    # causal chains (obs.trace.mint_cause): spans sharing one
+    # args.cause token are one shipment's cross-process journey —
+    # chains[token] = {span name -> [durs]}, plus the chain's time span
+    chains: dict = defaultdict(
+        lambda: {"links": defaultdict(list), "t0": None, "t1": None})
     for ev in events:
         if ev.get("ph") == "X":
             by_name[ev.get("name", "?")].append(float(ev.get("dur", 0.0)))
             tracks.add(ev.get("tid"))
+            cause = (ev.get("args") or {}).get("cause")
+            if cause:
+                ch = chains[cause]
+                ts = float(ev.get("ts", 0.0))
+                dur = float(ev.get("dur", 0.0))
+                ch["links"][ev.get("name", "?")].append(dur)
+                ch["t0"] = ts if ch["t0"] is None else min(ch["t0"], ts)
+                ch["t1"] = (ts + dur if ch["t1"] is None
+                            else max(ch["t1"], ts + dur))
             if ev.get("name") == "device_dispatch":
                 dev = (ev.get("args") or {}).get("device") or "(default)"
                 dev_busy[dev] += float(ev.get("dur", 0.0))
@@ -294,6 +322,42 @@ def inspect(path: str) -> dict:
                 "reconnect_ms": round(st["reconnect_ms"], 3),
                 "last_state": st["last_state"],
             }
+    causal = None
+    if chains:
+        # the canonical replication chain; a chain carrying all three
+        # links is "complete" — per-link attribution is computed over
+        # those, so partial chains (dropped shipment, wrapped ring)
+        # can't skew the hop shares
+        chain_links = ("ship_segment", "net_send", "replica_replay")
+        complete = {tok: ch for tok, ch in chains.items()
+                    if all(name in ch["links"] for name in chain_links)}
+        link_us: dict = defaultdict(float)
+        link_count: dict = defaultdict(int)
+        e2e_us_list = []
+        for ch in complete.values():
+            e2e_us_list.append((ch["t1"] or 0.0) - (ch["t0"] or 0.0))
+            for name, durs in ch["links"].items():
+                link_us[name] += sum(durs)
+                link_count[name] += len(durs)
+        total_link_us = sum(link_us.values())
+        causal = {
+            "chains": len(chains),
+            "complete_chains": len(complete),
+            "links": {
+                name: {"spans": link_count[name],
+                       "total_ms": round(us / 1e3, 3),
+                       "share": (round(us / total_link_us, 4)
+                                 if total_link_us else 0.0)}
+                for name, us in sorted(link_us.items())},
+            "chain_e2e_p50_us": round(percentile(e2e_us_list, 50), 3),
+            "chain_e2e_p99_us": round(percentile(e2e_us_list, 99), 3),
+            "span_names": sorted({name for ch in chains.values()
+                                  for name in ch["links"]}),
+        }
+        if require_chain:
+            causal["required_chains"] = sum(
+                1 for ch in chains.values()
+                if all(name in ch["links"] for name in require_chain))
     failover = None
     if failover_events or fence_rejects:
         failover = {
@@ -319,6 +383,7 @@ def inspect(path: str) -> dict:
         "per_device": per_device,
         "replication": replication,
         "network": network,
+        "causal": causal,
         "control_actions": control_actions,
         "spans": spans,
         "tickets": len(tickets),
@@ -384,6 +449,15 @@ def _print_human(s: dict) -> None:
                   f"{d['reconnects']}/{d['reconnect_attempts']} "
                   f"reconnect(s) in {d['reconnect_ms']:.2f}ms; "
                   f"state={d['last_state']}")
+    ca = s.get("causal")
+    if ca:
+        print(f"causal chains: {ca['complete_chains']}/{ca['chains']} "
+              f"complete — e2e p50 {ca['chain_e2e_p50_us']:.1f}us "
+              f"p99 {ca['chain_e2e_p99_us']:.1f}us")
+        for name, d in ca["links"].items():
+            print(f"  link {name}: {d['spans']} span(s) "
+                  f"{d['total_ms']:.2f}ms ({100 * d['share']:.1f}% of "
+                  f"chain link time)")
     fo = s.get("failover")
     if fo:
         rej = ", ".join(f"{v} {k}(s)"
@@ -427,12 +501,25 @@ def main(argv=None) -> int:
     ap.add_argument("trace")
     ap.add_argument("--json", action="store_true",
                     help="print the summary as one JSON line")
+    ap.add_argument("--require-chain", metavar="SPANS",
+                    help="comma-separated span names; exit 1 unless at "
+                         "least one causal chain carries them all")
     args = ap.parse_args(argv)
-    summary = inspect(args.trace)
+    want = [w.strip() for w in (args.require_chain or "").split(",")
+            if w.strip()]
+    summary = inspect(args.trace, require_chain=want or None)
     if args.json:
         print(json.dumps(summary))
     else:
         _print_human(summary)
+    if want:
+        ca = summary.get("causal")
+        got = ca.get("required_chains", 0) if ca else 0
+        if not got:
+            print(f"require-chain FAILED: no causal chain carries all "
+                  f"of {want} (chains={ca['chains'] if ca else 0})",
+                  file=sys.stderr)
+            return 1
     return 0
 
 
