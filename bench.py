@@ -182,6 +182,27 @@ Env knobs::
                                   telemetry-link partition (CPU-only)
     REFLOW_BENCH_FLEETOBS_BATCHES fixed-work batches per producer for
                                   the A/B legs (default 320, smoke 160)
+    REFLOW_BENCH_MULTIPROC=1      multi-process mode instead: a leader
+                                  + N replica + M producer fleet of
+                                  real OS processes (python -m
+                                  reflow_tpu.proc) pumping over the
+                                  ingestion RPC; a kill -9 storm takes
+                                  every replica (respawn + WAL
+                                  recovery + horizon-barrier rejoin)
+                                  and then the leader (cross-process
+                                  promotion; producers reconnect and
+                                  resubmit exactly-once); asserts zero
+                                  acked-write loss vs a deterministic
+                                  oracle, exact parity at equal
+                                  horizons on the survivors, an empty
+                                  in-doubt set on every producer, and
+                                  full fleet-telemetry coverage
+                                  (CPU-only)
+    REFLOW_BENCH_MULTIPROC_N      replica-process count     (default 3)
+    REFLOW_BENCH_MULTIPROC_PRODUCERS  producer-process count
+                                  (default 4)
+    REFLOW_BENCH_MULTIPROC_RUN_S  per-phase write window (s)
+                                  (default 1.5, smoke 0.6)
     REFLOW_TRACE_OUT              obs-mode chrome trace path
                                   (default /tmp/reflow_obs_trace.json;
                                   fleetobs default
@@ -2687,6 +2708,176 @@ def run_fleetobs_bench() -> dict:
     return out
 
 
+# -- multi-process mode (REFLOW_BENCH_MULTIPROC=1) -------------------------
+
+def run_multiproc_bench() -> dict:
+    """The multi-controller leg as real OS processes (docs/guide.md
+    "Multi-process deployment"): a leader + N replica + M producer
+    *process* fleet under a kill -9 storm.
+
+    Storm script: spawn the fleet (every child ships telemetry to the
+    parent's FleetAggregator), let the producers pump over the
+    ingestion RPC, then kill -9 every replica in turn (respawn each
+    over its state directory; it recovers from its mirrored WAL and
+    rejoins through the cross-process horizon barrier), then kill -9
+    the *leader* and drive a FailoverCoordinator whose candidates are
+    the replica processes — the winner promotes in-child and starts
+    serving ingestion; producers reconnect, resubmit their in-doubt
+    batches, and the dedup mirror keeps them exactly-once.
+
+    Hard asserts: zero acked-write loss (a DirtyScheduler oracle
+    refolds every acked batch — content regenerated from (producer,
+    seq) alone — and must equal the new leader's wire-read view
+    exactly); exact parity at equal horizons on every surviving
+    replica; the promotion happened (epoch 1, winner is a replica);
+    every producer exited with an empty in-doubt set; the reconnect /
+    resubmit paths actually fired; the fleet aggregator saw every
+    process. Host-side CPU work; children run with JAX_PLATFORMS=cpu.
+    """
+    import shutil
+    import tempfile
+
+    from reflow_tpu.proc import ProcHarness
+    from reflow_tpu.proc.worker import producer_batch_words
+    from reflow_tpu.proc.harness import ControlClient
+    from reflow_tpu.scheduler import DirtyScheduler
+    from reflow_tpu.workloads import wordcount
+
+    smoke = env_flag("REFLOW_BENCH_SMOKE")
+    n_replicas = max(2, env_int("REFLOW_BENCH_MULTIPROC_N", "3"))
+    n_prod = max(1, env_int("REFLOW_BENCH_MULTIPROC_PRODUCERS", "4"))
+    run_s = env_float("REFLOW_BENCH_MULTIPROC_RUN_S",
+                      "0.6" if smoke else "1.5")
+
+    # an oversubscribed host (fleet processes > cores) needs paced
+    # producers, or the spin-looping fleet starves a recovering child
+    n_procs = 1 + n_replicas + n_prod
+    pace_s = 0.02 if (os.cpu_count() or 1) < n_procs else 0.0
+    out = {"replicas": n_replicas, "producers": n_prod, "run_s": run_s,
+           "producer_pace_s": pace_s}
+    root = tempfile.mkdtemp(prefix="reflow-multiproc-")
+    h = ProcHarness(root, child_env={"JAX_PLATFORMS": "cpu"})
+    try:
+        h.spawn_leader(fsync="tick", epoch=0)
+        rnames = [f"r{i}" for i in range(n_replicas)]
+        for nm in rnames:
+            h.spawn_replica(nm)
+        h.attach_replicas()
+        for i in range(n_prod):
+            h.spawn_producer(f"p{i}", index=i, pace_s=pace_s)
+        fleet_target = 1 + n_replicas + n_prod
+        out["fleet_nodes_expected"] = fleet_target
+        out["fleet_nodes_seen"] = (
+            h.aggregator.await_nodes(fleet_target, timeout_s=15.0))
+        assert out["fleet_nodes_seen"], \
+            f"fleet aggregator saw {h.aggregator.node_count()} nodes, " \
+            f"wanted {fleet_target}"
+        time.sleep(run_s)
+
+        # -- kill -9 storm over the replica tier, one at a time -------
+        for nm in rnames:
+            h.kill9(nm)
+            time.sleep(0.1)
+            h.respawn(nm)
+            h.attach_replicas([nm])
+            h.barrier(timeout_s=60.0)  # the respawn rejoins the cut
+        time.sleep(run_s / 2)
+
+        # -- then the leader: cross-process failover ------------------
+        coord = h.coordinator(epoch=0, confirm_intervals=2,
+                              drain_timeout_s=10.0)
+        h.kill9("leader")
+        t_kill = time.monotonic()
+        promote_evt = None
+        now = 0.0
+        while promote_evt is None and time.monotonic() - t_kill < 60.0:
+            for e in coord.step(now):
+                if e.get("kind") == "failover_promote":
+                    promote_evt = e
+            now += 1.0
+            time.sleep(0.02)
+        assert promote_evt is not None, "leader death never promoted"
+        out["promotion_s"] = time.monotonic() - t_kill
+        out["winner"] = promote_evt["winner"]
+        out["epoch"] = promote_evt["epoch"]
+        out["drained_bytes"] = promote_evt["drained_bytes"]
+        assert out["winner"] in rnames
+        assert out["epoch"] == 1
+        assert h.leader_name == out["winner"]
+
+        # producers reconnect + resubmit against the recovered mirror
+        time.sleep(run_s)
+
+        # -- quiesce: stop producers (each drains its in-flight batch
+        # to a terminal ack), then flush the new leader over the wire
+        prod_exits = []
+        for i in range(n_prod):
+            st = h.child(f"p{i}").stop()
+            assert st is not None and st.get("ok"), \
+                f"producer p{i} died dirty: {st!r}"
+            prod_exits.append(st)
+        out["reconnects_total"] = sum(s["reconnects"]
+                                      for s in prod_exits)
+        out["resubmits_total"] = sum(s["resubmits"] for s in prod_exits)
+        out["deduped_total"] = sum(s["deduped"] for s in prod_exits)
+        for st in prod_exits:
+            assert st["in_doubt"] == [], \
+                f"{st['name']} exited in doubt: {st['in_doubt']}"
+        assert out["reconnects_total"] >= n_prod, \
+            "the leader kill never forced a producer reconnect"
+        assert out["resubmits_total"] >= 1
+
+        g, src, sink = wordcount.build_graph()
+        ingest = ControlClient(h.ingest_address, io_timeout_s=30.0)
+        ingest.call("flush", 20.0)
+        _, leader_tick, leader_view = ingest.call("view", sink.name)
+
+        # zero acked-write loss: refold every acked batch from
+        # (producer index, seq) alone — the content is deterministic
+        oracle = DirtyScheduler(g)
+        acked_batches = 0
+        for i, st in enumerate(prod_exits):
+            for seq, _status in st["acked"]:
+                words = " ".join(producer_batch_words(i, seq))
+                oracle.push(src, wordcount.ingest_lines([words]),
+                            batch_id=f"p{i}-{seq}")
+                acked_batches += 1
+        oracle.tick()
+        want = {kv: w for kv, w in oracle.view(sink.name).items()
+                if w != 0}
+        got = {kv: w for kv, w in leader_view.items() if w != 0}
+        diff = 0
+        for kv in set(want) | set(got):
+            diff = max(diff, abs(want.get(kv, 0) - got.get(kv, 0)))
+        out["acked_batches"] = acked_batches
+        out["acked_loss_max_abs_diff"] = diff
+        assert diff == 0, f"acked-write loss: max_abs_diff={diff}"
+
+        # exact parity at equal horizons on every surviving replica,
+        # read over each child's own wire protocol
+        survivors = [nm for nm in rnames if nm != h.leader_name]
+        h.barrier(names=survivors, min_horizon=leader_tick,
+                  timeout_s=30.0)
+        parity_diff = 0
+        for nm in survivors:
+            _, rh, rv = h.control(nm).call("view", sink.name)
+            assert rh == leader_tick, (nm, rh, leader_tick)
+            for kv in set(got) | set(rv):
+                parity_diff = max(
+                    parity_diff, abs(got.get(kv, 0) - rv.get(kv, 0)))
+        out["parity_max_abs_diff"] = parity_diff
+        assert parity_diff == 0
+
+        out["leader_tick"] = leader_tick
+        out["kills"] = h.kills
+        out["respawns"] = h.respawns
+        assert h.kills == n_replicas + 1 and h.respawns == n_replicas
+    finally:
+        h.close()
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 # -- tier / multi-graph serving mode (REFLOW_BENCH_TIER=1) -----------------
 
 def run_tier_bench() -> dict:
@@ -3803,6 +3994,19 @@ def main() -> None:
             "unit": "frac",
             **out,
         }, json_out, mode="fleetobs")
+        return
+
+    if env_flag("REFLOW_BENCH_MULTIPROC"):
+        # multiproc mode spawns its own CPU-pinned children; the
+        # parent does host-side control work only — no tunnel
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        out = run_multiproc_bench()
+        _emit({
+            "metric": "multiproc_promotion_s",
+            "value": out["promotion_s"],
+            "unit": "s",
+            **out,
+        }, json_out, mode="multiproc")
         return
 
     if env_flag("REFLOW_BENCH_OBS"):

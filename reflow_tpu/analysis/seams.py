@@ -1,10 +1,11 @@
 """Seam-hygiene pass: CrashInjector seam strings.
 
-Every crash seam in the tree — a literal passed to ``_crash_point`` or
-``CrashInjector.point`` — is a differential-testing contract: recovery
-tests arm ``CrashInjector(at=N, only=<seam>)`` and assert the
-exactly-once invariants around that exact cut. Two rules keep the
-contract honest:
+Every crash seam in the tree — a literal passed to ``_crash_point``,
+``_chaos_point`` (the process harness's kill/respawn seams, scoped
+``proc_*@<node>``) or ``CrashInjector.point`` — is a
+differential-testing contract: recovery tests arm
+``CrashInjector(at=N, only=<seam>)`` and assert the exactly-once
+invariants around that exact cut. Two rules keep the contract honest:
 
 - **seam-grammar** — the seam name must be ``lower_snake`` and, when a
   graph scope is attached, follow ``<seam>@<graph>``. Call sites that
@@ -45,7 +46,7 @@ def _seam_literals(tree: ast.AST) -> List[Tuple[str, int, bool]]:
         f = node.func
         attr = f.attr if isinstance(f, ast.Attribute) else (
             f.id if isinstance(f, ast.Name) else None)
-        if attr not in ("_crash_point", "point"):
+        if attr not in ("_crash_point", "_chaos_point", "point"):
             continue
         if attr == "point":
             # only CrashInjector-ish receivers: self._crash.point(...)

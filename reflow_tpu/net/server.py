@@ -16,7 +16,17 @@ Requests (pickled tuples, ``net/framing.py``)::
                                         | ("nack", cursor, reason)
     ("ping",)                          -> ("ok", {name, horizon, epoch,
                                                   lag_ticks})
+    ("view", sink_name)                -> ("ok", horizon, {key: weight})
     anything else                      -> ("err", text)
+
+Addressing: ``start()`` binds whatever the transport's listener
+reports — under :class:`~reflow_tpu.net.transport.TcpTransport` that
+is port 0 by default, so the OS assigns a free port and ``address``
+is the authoritative ``(host, port)`` to advertise. Callers must read
+``address`` *after* ``start()`` rather than pre-picking ports; this
+is what lets the process harness spawn many replica processes in
+parallel (each child prints its assigned address on its ready line)
+without port collisions.
 
 Concurrency: one accept-loop thread plus one handler thread per
 connection. Multiple concurrent clients are not an edge case — during
@@ -168,6 +178,11 @@ class ReplicaServer:
                 "lag_ticks": r.lag_ticks() if hasattr(r, "lag_ticks")
                 else 0,
             })
+        if op == "view":
+            # published view at a consistent cut — parity checks across
+            # process boundaries (bench oracle, harness barrier probes)
+            horizon, view = r.view_at(args[0])
+            return ("ok", horizon, dict(view))
         return ("err", f"unknown op {op!r}")
 
     def close(self) -> None:

@@ -364,14 +364,22 @@ class _TcpListener(Listener):
 
 
 class TcpTransport(Transport):
-    """Real sockets on ``host`` (default 127.0.0.1; ``listen`` binds an
-    ephemeral port and ``Listener.address`` reports it)."""
+    """Real sockets on ``host``. ``listen`` binds ``port`` — default 0,
+    i.e. the OS assigns an ephemeral port and ``Listener.address``
+    reports the ``(host, port)`` actually bound. Servers built on this
+    (``ReplicaServer`` / ``RpcIngestServer`` / ``TelemetryServer``)
+    therefore never need a pre-picked port: start one, read
+    ``.address``, hand it to whoever dials — which is what lets the
+    process harness spawn children in parallel without collisions.
+    Pass an explicit ``port`` only to pin a deployment-known endpoint.
+    """
 
-    def __init__(self, host: str = "127.0.0.1") -> None:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0) -> None:
         self.host = host
+        self.port = port
 
     def listen(self) -> Listener:
-        return _TcpListener(self.host, 0)
+        return _TcpListener(self.host, self.port)
 
     def connect(self, address, timeout_s: Optional[float] = None) -> Conn:
         timeout_s = env_float("REFLOW_NET_CONNECT_TIMEOUT_S") \

@@ -213,6 +213,20 @@ class FleetAggregator:
         with self._lock:
             return len(self._rings)
 
+    def await_nodes(self, n: int, timeout_s: float = 10.0,
+                    poll_s: float = 0.02) -> bool:
+        """Block until at least ``n`` distinct nodes have shipped a
+        snapshot (the process harness's "fleet is up" gate: a child
+        counts as joined once its first telemetry beat lands). Returns
+        False on timeout — telemetry loss is tolerated by design, so
+        callers decide whether an incomplete fleet is an error."""
+        deadline = self._clock() + timeout_s
+        while self.node_count() < n:
+            if self._clock() >= deadline:
+                return False
+            time.sleep(poll_s)
+        return True
+
     # -- per-node derivation -------------------------------------------
 
     def _node_entry(self, ring: deque, now: float) -> Dict[str, Any]:

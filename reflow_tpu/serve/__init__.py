@@ -22,6 +22,8 @@ from .frontend import IngestFrontend
 from .queues import batch_nbytes
 from .read import LeaderReadAdapter, ReadResult, ReadTier, StaleRead
 from .replica import ReplicaScheduler
+from .rpc import (RemoteProducer, RemoteTicket, RpcIngestServer,
+                  SubmitAck, SubmitReq, TicketResolve)
 from .tickets import (APPLIED, DEDUPED, REJECTED, SHED, FrontendClosed,
                       PumpCrashed, Ticket, TicketResult)
 from .tier import GraphConfig, GraphHandle, ServeTier, dwrr_pick
@@ -33,7 +35,8 @@ __all__ = [
     "ElectionPolicy", "FailoverCoordinator", "Feed", "FrontendClosed",
     "GraphConfig", "GraphHandle", "HighestHorizonElection",
     "IngestFrontend", "LeaderReadAdapter", "PumpCrashed", "ReadResult",
-    "ReadTier", "ReplicaScheduler", "SLOSpec", "ServeTier", "StaleRead",
-    "Ticket", "TicketResult", "batch_nbytes", "build_feeds", "dwrr_pick",
-    "load_slo_specs",
+    "ReadTier", "RemoteProducer", "RemoteTicket", "ReplicaScheduler",
+    "RpcIngestServer", "SLOSpec", "ServeTier", "StaleRead", "SubmitAck",
+    "SubmitReq", "Ticket", "TicketResolve", "TicketResult",
+    "batch_nbytes", "build_feeds", "dwrr_pick", "load_slo_specs",
 ]

@@ -430,6 +430,15 @@ class IngestFrontend:
         while len(self._admitted) > self.sched.dedup_window:
             self._admitted.pop(next(iter(self._admitted)))
 
+    def admitted_ids(self, batch_ids) -> list:
+        """Which of ``batch_ids`` the dedup mirror currently remembers.
+        The ingestion RPC's reconnect handshake: a producer that died
+        in an ack window sends its in-doubt ids here, then resubmits —
+        a remembered id resolves DEDUPED, keeping resubmission
+        exactly-once without the producer ever guessing."""
+        with self._lock:
+            return [b for b in batch_ids if b in self._admitted]
+
     # -- lifecycle ---------------------------------------------------------
 
     def flush(self, timeout: Optional[float] = None) -> None:

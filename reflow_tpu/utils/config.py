@@ -321,6 +321,46 @@ declare("REFLOW_BENCH_FLEETOBS_BATCHES", "int", None,
         "fleetobs bench batches per producer per A/B leg "
         "(default 320, smoke 160)")
 
+# -- ingestion RPC + process harness ('Multi-process deployment') -----------
+
+declare("REFLOW_RPC_IO_TIMEOUT_S", "float", 5.0,
+        "per-operation send/recv timeout on ingestion RPC "
+        "connections (RemoteProducer <-> RpcIngestServer)")
+declare("REFLOW_RPC_SUBMIT_TIMEOUT_S", "float", 30.0,
+        "server-side cap on how long one RPC submit may block in "
+        "frontend admission (policy='block' backpressure) before "
+        "the producer is told to retry")
+declare("REFLOW_RPC_RESOLVE_WAIT_S", "float", 0.2,
+        "server-side cap on one resolve poll's wait for a ticket to "
+        "turn terminal (client long-polls in slices of this)")
+declare("REFLOW_RPC_TICKETS", "int", 4096,
+        "ingest server ticket-table bound; oldest resolved tickets "
+        "are evicted first (an evicted in-flight ticket resolves as "
+        "'unknown' and the producer resubmits — dedup keeps it "
+        "exactly-once)")
+declare("REFLOW_PROC_READY_TIMEOUT_S", "float", 30.0,
+        "harness deadline for a spawned child process to print its "
+        "ready line (addresses + pid)")
+declare("REFLOW_PROC_REAP_TIMEOUT_S", "float", 10.0,
+        "harness deadline for a stopping child to exit before it is "
+        "SIGKILLed (a hung child can't wedge the suite)")
+declare("REFLOW_PROC_POLL_S", "float", 0.05,
+        "harness poll slice for child liveness / barrier probes")
+declare("REFLOW_PROC_PYTHON", "str", None,
+        "interpreter used to spawn harness children "
+        "(default sys.executable)")
+declare("REFLOW_BENCH_MULTIPROC", "flag", False,
+        "bench mode: multi-process chaos — producer + replica OS "
+        "processes, kill -9 storm, leader kill + cross-process "
+        "promotion, exactly-once resubmit over the RPC")
+declare("REFLOW_BENCH_MULTIPROC_N", "int", 3,
+        "multiproc bench replica process count")
+declare("REFLOW_BENCH_MULTIPROC_PRODUCERS", "int", 4,
+        "multiproc bench producer process count")
+declare("REFLOW_BENCH_MULTIPROC_RUN_S", "float", None,
+        "multiproc bench per-phase write window seconds "
+        "(default 1.5, smoke 0.6)")
+
 
 # -- the config dataclass ---------------------------------------------------
 

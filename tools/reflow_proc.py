@@ -1,0 +1,33 @@
+#!/usr/bin/env python3
+"""Checkout-relative wrapper for ``python -m reflow_tpu.proc``.
+
+Usage::
+
+    python tools/reflow_proc.py --role leader   --name leader --root DIR
+    python tools/reflow_proc.py --role replica  --name r0 --root DIR \\
+        --telemetry HOST:PORT
+    python tools/reflow_proc.py --role producer --name p0 --index 0 \\
+        --connect HOST:PORT --json
+
+Runs one multi-process deployment role (docs/guide.md "Multi-process
+deployment"): a leader (durable scheduler + ingestion RPC + WAL
+shipper), a replica (mirrored WAL + shipping/control endpoint), or a
+producer (deterministic batch stream over the ingestion RPC). The
+first stdout line is the ready JSON with the OS-assigned addresses;
+``--json`` adds an exit-status JSON on clean shutdown. The process
+harness spawns children through the ``-m`` form; this wrapper exists
+so an operator inside a checkout gets the identical entrypoint without
+installing the package.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from reflow_tpu.proc.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

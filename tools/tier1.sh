@@ -402,4 +402,41 @@ print(f"TIER1 fleetobs consumers: fleet/1 snapshot ok "
       f"{d['stamped']} stamped / {d['unstamped']} pre-stamp")
 EOF
 fi
+
+# optional (RUN_BENCH=1): the multiproc smoke — the whole control
+# plane as real OS processes (leader + replicas + remote producers
+# over the ingestion RPC), a kill -9 storm over every replica
+# (respawn, recover over the mirrored WAL, rejoin through the
+# cross-process horizon barrier) and then the leader (cross-process
+# promotion; producers retarget and resubmit through the hello dedup
+# handshake): zero acked-write loss vs a deterministic refold oracle,
+# exact survivor parity at the promoted leader's horizon, empty
+# in-doubt set on every producer, every kill accounted for. Children
+# are reaped with deadlines — a wedged child fails the smoke instead
+# of hanging it.
+if [ "${RUN_BENCH:-0}" = "1" ] && [ $rc -eq 0 ]; then
+  REFLOW_BENCH_MULTIPROC=1 REFLOW_BENCH_SMOKE=1 JAX_PLATFORMS=cpu \
+    timeout -k 10 590 python bench.py --json-out /tmp/_t1_multiproc.json \
+    > /dev/null || rc=3
+  python - <<'EOF' || rc=3
+import json
+r = json.load(open("/tmp/_t1_multiproc.json"))
+assert r["acked_loss_max_abs_diff"] == 0, r
+assert r["parity_max_abs_diff"] == 0, r
+assert r["epoch"] == 1, r
+assert r["fleet_nodes_seen"], r
+assert r["reconnects_total"] >= r["producers"], r
+assert r["resubmits_total"] >= 1, r
+assert r["kills"] == r["replicas"] + 1, r
+assert r["respawns"] == r["replicas"], r
+print(f"TIER1 multiproc smoke: {r['replicas']} replica + "
+      f"{r['producers']} producer processes — {r['kills']} kill -9s, "
+      f"{r['respawns']} respawns, {r['winner']} promoted to epoch "
+      f"{r['epoch']} in {r['promotion_s']}s; {r['acked_batches']} "
+      f"acked batches, zero loss, survivor parity exact at tick "
+      f"{r['leader_tick']}; {r['reconnects_total']} reconnect(s), "
+      f"{r['resubmits_total']} resubmit(s), {r['deduped_total']} "
+      f"deduped")
+EOF
+fi
 exit $rc
