@@ -128,6 +128,22 @@ Env knobs::
                                   the published horizon (CPU-only)
     REFLOW_BENCH_REPLICA_N        follower count            (default 4)
     REFLOW_BENCH_REPLICA_READ_S   per-leg read window (s)   (default 2.0)
+    REFLOW_BENCH_SUBS=1           reactive-reads mode instead: one
+                                  replica's SubscriptionHub fans
+                                  per-window deltas to N simulated
+                                  subscribers (plus real wire
+                                  subscribers through a mid-run
+                                  partition + heal) under sustained
+                                  16-producer writes; asserts exact
+                                  push-vs-pull parity at equal
+                                  horizons, zero gaps / zero duplicate
+                                  applies on resume, and write-path
+                                  admission p99 within 2x the
+                                  no-subscriber baseline (CPU-only)
+    REFLOW_BENCH_SUBS_N           simulated subscriber count
+                                  (default 100_000, smoke 2000)
+    REFLOW_BENCH_SUBS_RUN_S       per-leg write window (s)
+                                  (default 2.0, smoke 0.6)
     REFLOW_BENCH_FAILOVER=1       failover mode instead: kill the leader
                                   (committer crash seam) under sustained
                                   16-producer writes; a
@@ -1361,6 +1377,328 @@ def run_replica_bench() -> dict:
         for r in replicas:
             r.close()
         shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+# -- reactive-reads mode (REFLOW_BENCH_SUBS=1) ------------------------------
+
+
+def _subs_query_pool(sink_name: str, vocab: int, n: int) -> list:
+    """``n`` distinct standing queries mixing the three kinds. Lookups
+    dominate (they are what 100k real subscribers look like: each
+    watching its own key); topk/view ride along so every fan-out round
+    exercises the expensive paths too. The hub keys fan-out state by
+    *distinct* query, so subscriber count and query diversity are
+    independent axes — the bench stresses both."""
+    pool = []
+    for i in range(n):
+        m = i % 8
+        if m < 5:
+            pool.append((sink_name, "lookup", ((f"w{i % vocab}", 1.0),)))
+        elif m < 7:
+            pool.append((sink_name, "topk", (5 + 5 * (m - 4), "weight")))
+        else:
+            pool.append((sink_name, "view", ()))
+    return pool
+
+
+def run_subs_bench() -> dict:
+    """Reactive reads (docs/guide.md "Reactive reads"): one replica's
+    :class:`~reflow_tpu.subs.hub.SubscriptionHub` fanning per-window
+    deltas to ``REFLOW_BENCH_SUBS_N`` simulated subscribers (in-process
+    :class:`SubHandle`\\ s — the same state machine the wire client
+    wraps) while 16 producers write through the durable leader.
+
+    Two identically-loaded write legs run back to back:
+
+    - **baseline**: leader + shipper + replica, no hub — the write
+      path's admission p99 with nobody watching;
+    - **subs**: the same topology with the hub attached, N in-process
+      subscribers standing on a mixed query pool, and a few real wire
+      subscribers over loopback that live through a mid-run
+      partition + heal of their endpoint.
+
+    Property checks, each a hard assert:
+
+    - **push == pull**: sampled subscribers' delta-reconstructed
+      answers equal ``view_at``/``lookup``/top-k at the same horizon
+      with ``max_abs_diff == 0``, and reach it with zero gaps and zero
+      duplicate applies;
+    - **partition/heal**: every wire subscriber resumes (``mode ==
+      "resume"`` — cursor, not re-snapshot) with ``gaps_total == 0``
+      and ``dups_skipped_total == 0``;
+    - **write path immune**: the subs leg's admission p99 stays within
+      2x the no-subscriber baseline (plus a small absolute floor so a
+      sub-millisecond baseline doesn't turn timer noise into a fail).
+
+    Host-side CPU work; runs on the CPU executor/platform."""
+    import shutil
+    import tempfile
+    import threading
+
+    from reflow_tpu.net import LoopbackTransport, ReconnectPolicy
+    from reflow_tpu.serve import (CoalesceWindow, IngestFrontend,
+                                  ReplicaScheduler)
+    from reflow_tpu.subs import (Subscriber, SubscriptionHub,
+                                 SubscriptionServer)
+    from reflow_tpu.subs.query import topk_rows
+    from reflow_tpu.wal import DurableScheduler, SegmentShipper
+    from reflow_tpu.workloads import wordcount
+
+    smoke = env_flag("REFLOW_BENCH_SMOKE")
+    n_subs = env_int("REFLOW_BENCH_SUBS_N") or (2_000 if smoke
+                                                else 100_000)
+    run_s = env_float("REFLOW_BENCH_SUBS_RUN_S") or (0.6 if smoke
+                                                     else 2.0)
+    n_producers = 16
+    n_wire = 3
+    window_ticks = 4
+    vocab = 2_000 if smoke else 20_000
+    n_distinct = min(n_subs, 64 if smoke else 512)
+    n_sampled = min(n_subs, 32)
+
+    out = {"subscribers": n_subs, "distinct_queries": n_distinct,
+           "wire_subscribers": n_wire, "producers": n_producers,
+           "run_s": run_s, "vocab": vocab}
+
+    def write_leg(tag: str, with_subs: bool) -> dict:
+        tmp = tempfile.mkdtemp(prefix=f"reflow-subs-{tag}-")
+        fe = ship = rep = hub = srv = srv2 = None
+        wire_subs = []
+        pumpers = []
+        pump_stop = threading.Event()
+        leg = {}
+        try:
+            g, src, sink = wordcount.build_graph()
+            sched = DurableScheduler(g, wal_dir=os.path.join(tmp, "wal"),
+                                     fsync="tick", committer="thread",
+                                     segment_bytes=1 << 20)
+            fe = IngestFrontend(sched, window=CoalesceWindow(
+                max_rows=65536, max_ticks=window_ticks,
+                max_latency_s=0.002))
+            ship = SegmentShipper(sched.wal,
+                                  leader_tick=lambda: sched._tick,
+                                  poll_s=0.001)
+            gr, _s, _k = wordcount.build_graph()
+            rep = ReplicaScheduler(gr, os.path.join(tmp, "r0"),
+                                   name="r0")
+            ship.attach(rep)
+            ship.start()
+
+            handles = []
+            sampled = []
+            pool = _subs_query_pool(sink.name, vocab, n_distinct)
+            if with_subs:
+                hub = SubscriptionHub(rep, name="r0")
+                rep.attach_hub(hub)
+                t0 = time.perf_counter()
+                for i in range(n_subs):
+                    q = pool[i % len(pool)]
+                    handles.append((hub.open(q[0], q[1], q[2]), q))
+                leg["open_s"] = round(time.perf_counter() - t0, 3)
+                step = max(1, n_subs // n_sampled)
+                sampled = handles[::step][:n_sampled]
+                lt = LoopbackTransport()
+                srv = SubscriptionServer(hub, lt).start()
+                for i in range(n_wire):
+                    q = pool[i % len(pool)]
+                    wire_subs.append(Subscriber(
+                        lt, srv.address, q[0], kind=q[1], params=q[2],
+                        name=f"bench-wire-{i}",
+                        policy=ReconnectPolicy(f"bench-wire-{i}",
+                                               base_s=0.01, cap_s=0.05,
+                                               jitter=0.0)))
+
+                def pump_forever(sub):
+                    # never raises while the link is down — the whole
+                    # point of the partition leg
+                    while not pump_stop.is_set():
+                        sub.pump(wait_s=0.05)
+
+                pumpers = [threading.Thread(target=pump_forever,
+                                            args=(s,))
+                           for s in wire_subs]
+                for t in pumpers:
+                    t.start()
+
+            # -- sustained 16-producer writes for the measured window
+            stop = threading.Event()
+            submitted = [0] * n_producers
+
+            def produce(pid):
+                rng = np.random.default_rng(1000 + pid)
+                seq = 0
+                while not stop.is_set():
+                    words = " ".join(
+                        f"w{int(x)}" for x in rng.integers(0, vocab, 24))
+                    try:
+                        fe.submit(src, wordcount.ingest_lines([words]),
+                                  batch_id=f"p{pid}-{seq}")
+                    except Exception:
+                        break
+                    seq += 1
+                submitted[pid] = seq
+
+            producers = [threading.Thread(target=produce, args=(pid,))
+                         for pid in range(n_producers)]
+            for t in producers:
+                t.start()
+
+            if with_subs:
+                # partition the subscription endpoint mid-run, heal it
+                # while writes are still flowing — the resume contract
+                # has to hold under load, not at quiesce
+                time.sleep(run_s * 0.5)
+                srv.close()
+                time.sleep(run_s * 0.25)
+                srv2 = SubscriptionServer(hub, lt).start()
+                for s in wire_subs:
+                    s.retarget(srv2.address)
+                time.sleep(run_s * 0.25)
+            else:
+                time.sleep(run_s)
+
+            # -- quiesce: land everything, replica catches up
+            stop.set()
+            for t in producers:
+                t.join()
+            p99 = float(np.percentile(list(fe.admission_s), 99)) \
+                if fe.admission_s else 0.0
+            fe.flush()
+            sched.wal.sync()
+            deadline = time.monotonic() + 60
+            while (rep.published_horizon() != sched._tick
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            ship.stop()
+            ship.pump_once()
+            assert rep.published_horizon() == sched._tick, \
+                (rep.published_horizon(), sched._tick)
+            horizon = sched._tick
+            leg["admission_p99_us"] = round(p99 * 1e6, 1)
+            leg["total_batches"] = sum(submitted)
+            leg["leader_ticks"] = horizon
+
+            if with_subs:
+                # fan-out settles to the replica's published horizon
+                deadline = time.monotonic() + 30
+                while (hub.fanout_horizon < horizon
+                       and time.monotonic() < deadline):
+                    time.sleep(0.005)
+                assert hub.fanout_horizon == horizon, \
+                    (hub.fanout_horizon, horizon)
+
+                # push == pull, zero gaps, zero duplicate applies
+                view = rep.view_at(sink.name)[1]
+                max_abs_diff = 0.0
+                gaps = dups = 0
+                for h, q in sampled:
+                    assert h.wait_horizon(horizon, timeout_s=10.0), \
+                        (q, h.horizon, horizon)
+                    got = h.value()
+                    if q[1] == "view":
+                        for kv in set(got) | set(view):
+                            max_abs_diff = max(
+                                max_abs_diff,
+                                abs(got.get(kv, 0) - view.get(kv, 0)))
+                    elif q[1] == "lookup":
+                        max_abs_diff = max(
+                            max_abs_diff,
+                            abs(got - view.get(q[2][0], 0)))
+                    else:
+                        k, by = q[2]
+                        assert got == topk_rows(view, k, by), (q, got)
+                    gaps += h.state.gaps
+                    dups += h.state.dups_skipped
+                assert max_abs_diff == 0, max_abs_diff
+                assert gaps == 0 and dups == 0, (gaps, dups)
+
+                # wire subscribers: gap-free, dup-free resume through
+                # the partition/heal
+                pump_stop.set()
+                for t in pumpers:
+                    t.join()
+                for s in wire_subs:
+                    deadline = time.monotonic() + 10
+                    while (s.horizon < horizon
+                           and time.monotonic() < deadline):
+                        s.pump(wait_s=0.05)
+                    assert s.horizon >= horizon, (s.name, s.horizon,
+                                                  horizon)
+                    assert s.mode == "resume", (s.name, s.mode)
+                    assert s.gaps_total == 0, s.name
+                    assert s.dups_skipped_total == 0, s.name
+                    assert s.reconnects_total >= 1, s.name
+                    if s.query.kind == "view":
+                        assert s.value() == view
+                    elif s.query.kind == "lookup":
+                        assert s.value() == view.get(s.query.params[0],
+                                                     0)
+                    else:
+                        k, by = s.query.params
+                        assert s.value() == topk_rows(view, k, by)
+
+                leg["sampled_subscribers"] = len(sampled)
+                leg["parity_max_abs_diff"] = max_abs_diff
+                leg["frames_total"] = hub.frames_total
+                leg["fanout_rows_total"] = hub.fanout_rows_total
+                leg["fanout_rows_per_s"] = round(
+                    hub.fanout_rows_total / run_s, 1)
+                leg["conflations_total"] = hub.conflations_total
+                leg["sheds_total"] = hub.sheds_total
+                leg["active_subs"] = hub.active_subs()
+                leg["slowest_lag"] = hub.slowest_lag()
+                leg["wire_reconnects"] = sum(s.reconnects_total
+                                             for s in wire_subs)
+        finally:
+            pump_stop.set()
+            for t in pumpers:
+                t.join(timeout=5.0)
+            for s in wire_subs:
+                s.close()
+            for s in (srv, srv2):
+                if s is not None:
+                    s.close()
+            if hub is not None:
+                hub.close()
+            if fe is not None:
+                fe.close()
+            if ship is not None:
+                ship.close()
+            if rep is not None:
+                rep.close()
+            shutil.rmtree(tmp, ignore_errors=True)
+        return leg
+
+    base = write_leg("base", with_subs=False)
+    log(f"subs[baseline]: admission p99 "
+        f"{base['admission_p99_us']:.0f}us, "
+        f"{base['total_batches']} batches, no subscribers")
+    subs = write_leg("subs", with_subs=True)
+    log(f"subs[{n_subs}-subscriber leg]: admission p99 "
+        f"{subs['admission_p99_us']:.0f}us, "
+        f"{subs['total_batches']} batches, "
+        f"{subs['fanout_rows_per_s']} fan-out rows/s, "
+        f"{subs['conflations_total']} conflations, "
+        f"{subs['sheds_total']} sheds, parity diff "
+        f"{subs['parity_max_abs_diff']}, "
+        f"{subs['wire_reconnects']} wire reconnects")
+
+    p99_base = base["admission_p99_us"]
+    p99_subs = subs["admission_p99_us"]
+    out["baseline"] = base
+    out["subs"] = subs
+    out["write_p99_overhead_x"] = round(p99_subs / p99_base, 3) \
+        if p99_base else 0.0
+    # the bound: 2x the baseline, with an absolute floor so a
+    # microsecond-scale baseline doesn't turn scheduler jitter into a
+    # spurious fail on a loaded host
+    bound_us = max(2.0 * p99_base, p99_base + 5_000.0)
+    out["write_p99_bound_us"] = round(bound_us, 1)
+    out["write_p99_bounded"] = p99_subs <= bound_us
+    assert p99_subs <= bound_us, (p99_subs, bound_us)
+    log(f"subs[overhead]: write p99 {out['write_p99_overhead_x']}x "
+        f"baseline (bounded={out['write_p99_bounded']})")
     return out
 
 
@@ -3946,6 +4284,18 @@ def main() -> None:
             "unit": "x",
             **out,
         }, json_out, mode="replica")
+        return
+
+    if env_flag("REFLOW_BENCH_SUBS"):
+        # subs mode is host-side CPU work over loopback — no tunnel
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        out = run_subs_bench()
+        _emit({
+            "metric": "subs_write_p99_overhead_x",
+            "value": out["write_p99_overhead_x"],
+            "unit": "x",
+            **out,
+        }, json_out, mode="subs")
         return
 
     if env_flag("REFLOW_BENCH_COMPACT"):

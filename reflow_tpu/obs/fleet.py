@@ -253,6 +253,10 @@ class FleetAggregator:
                 gauges.get("compact.reclaimable_bytes")),
             "ship_backlog_segments": _num(
                 gauges.get("ship.backlog_segments")),
+            "subs_active": _num(gauges.get("subs.active")),
+            "sub_rows_s": self._sub_rows_s(ring),
+            "sub_conflations": self._sub_conflations(gauges),
+            "sub_lag_windows": _num(gauges.get("subs.slowest_lag")),
         }
         brownout = {k: v for k, v in gauges.items() if "brownout" in k}
         if brownout:
@@ -284,6 +288,34 @@ class FleetAggregator:
             return None
         return max(0.0, (rn - ro) / (tn - to))
 
+    @staticmethod
+    def _sub_conflations(gauges: Dict[str, Any]) -> Optional[float]:
+        total, seen = 0.0, False
+        for key in ("subs.conflations_total", "subs.sheds_total"):
+            v = _num(gauges.get(key))
+            if v is not None:
+                total += v
+                seen = True
+        return total if seen else None
+
+    def _sub_rows_s(self, ring: deque) -> Optional[float]:
+        """Fan-out row rate, derived exactly like ``_read_qps``: the
+        cumulative ``subs.fanout_rows_total`` counter differenced over
+        the sender's monotonic clock. None until a node ships two
+        snapshots that carry the gauge (old fleets never do)."""
+        if len(ring) < 2:
+            return None
+        new, old = ring[-1][1], ring[0][1]
+        rn = _num((new.get("gauges", {}) or {}).get(
+            "subs.fanout_rows_total"))
+        ro = _num((old.get("gauges", {}) or {}).get(
+            "subs.fanout_rows_total"))
+        tn, to = _num(new.get("ts_mono")), _num(old.get("ts_mono"))
+        if rn is None or ro is None or tn is None or to is None \
+                or tn <= to:
+            return None
+        return max(0.0, (rn - ro) / (tn - to))
+
     # -- the fleet view -------------------------------------------------
 
     def fleet_snapshot(self) -> Dict[str, Any]:
@@ -307,6 +339,12 @@ class FleetAggregator:
                 if e["compact_debt_bytes"] is not None]
         backlog = [e["ship_backlog_segments"] for e in nodes.values()
                    if e["ship_backlog_segments"] is not None]
+        subs = [e["subs_active"] for e in nodes.values()
+                if e["subs_active"] is not None]
+        sub_rows = [e["sub_rows_s"] for e in nodes.values()
+                    if e["sub_rows_s"] is not None]
+        sub_lag = [e["sub_lag_windows"] for e in nodes.values()
+                   if e["sub_lag_windows"] is not None]
         link_states: Dict[str, int] = {}
         for e in nodes.values():
             for state in e["conn_states"].values():
@@ -323,6 +361,9 @@ class FleetAggregator:
             "aggregate_read_qps": round(sum(qps), 3) if qps else None,
             "compact_debt_bytes": sum(debt) if debt else None,
             "ship_backlog_segments": max(backlog) if backlog else None,
+            "subs_active": int(sum(subs)) if subs else None,
+            "sub_rows_s": round(sum(sub_rows), 3) if sub_rows else None,
+            "sub_lag_windows": max(sub_lag) if sub_lag else None,
             "link_states": link_states,
             "max_age_s": round(max(
                 (e["age_s"] for e in nodes.values()), default=0.0), 4),

@@ -44,6 +44,8 @@ from reflow_tpu.obs.registry import REGISTRY
 from reflow_tpu.serve import (APPLIED, DEDUPED, IngestFrontend,
                               RemoteProducer, ReplicaScheduler,
                               RpcIngestServer)
+from reflow_tpu.subs.hub import SubscriptionHub
+from reflow_tpu.subs.wire import SubscriptionServer
 from reflow_tpu.utils.runtime import named_lock
 from reflow_tpu.wal.durable import DurableScheduler
 from reflow_tpu.wal.ship import SegmentShipper
@@ -164,6 +166,14 @@ class ReplicaNode:
         self.graph, self.src, self.sink = _graph(workload)
         self.rep = ReplicaScheduler(self.graph, root, name=name)
         self.server = ControlledReplicaServer(self, TcpTransport(host))
+        #: standing-query fan-out: every replica child serves
+        #: subscriptions beside the shipping endpoint
+        self.hub = SubscriptionHub(self.rep, name=name, start=False)
+        self.subs_server = SubscriptionServer(self.hub,
+                                              TcpTransport(host))
+        # cached at start: status() must keep answering on the exit
+        # path, after the listener (and its getsockname) is gone
+        self.subs_address: Optional[tuple] = None
         self.frontend: Optional[IngestFrontend] = None
         self.ingest: Optional[RpcIngestServer] = None
         self.ingest_address: Optional[tuple] = None
@@ -173,7 +183,12 @@ class ReplicaNode:
 
     def start(self) -> "ReplicaNode":
         self.rep.publish_metrics(REGISTRY)
+        self.rep.attach_hub(self.hub)
+        self.hub.start()
+        self.hub.publish_metrics(REGISTRY)
         self.server.start()
+        self.subs_server.start()
+        self.subs_address = tuple(self.subs_server.address)
         return self
 
     def status(self) -> dict:
@@ -186,6 +201,9 @@ class ReplicaNode:
             "promoted": r.promoted,
             "ingest": (list(self.ingest_address)
                        if self.ingest_address is not None else None),
+            "subs": (list(self.subs_address)
+                     if self.subs_address is not None else None),
+            "subs_active": self.hub.active_subs(),
         }
 
     def promote(self, epoch: int, attach, durable_kw: dict) -> dict:
@@ -220,6 +238,8 @@ class ReplicaNode:
             self.shipper.stop()
         if self.ingest is not None:
             self.ingest.close()
+        self.subs_server.close()
+        self.hub.close()
         self.server.close()
 
 
@@ -230,7 +250,8 @@ def run_replica(opts: dict) -> dict:
     node.start()
     telemetry = _telemetry(opts, opts["name"])
     emit({"event": "ready", "role": "replica", "name": node.name,
-          "pid": os.getpid(), "addr": list(node.server.address)})
+          "pid": os.getpid(), "addr": list(node.server.address),
+          "subs": list(node.subs_address)})
     cmds = _stdin_commands()
     try:
         while True:

@@ -432,6 +432,18 @@ class ControlConfig:
     #: consecutive idle intervals before a quiet graph's budget floor
     #: is reclaimed tier-wide (0 disables)
     reclaim_idle_intervals: int = 0
+    # -- subscription shedding (ControlPlane(subs=hub)) --
+    #: queued fan-out windows above which subscription fan-out counts
+    #: as breaching (the hub is falling behind the apply path); None
+    #: disables the backlog signal
+    sub_backlog_windows_max: Optional[int] = 8
+    #: slowest-subscriber lag (ticks behind the fan-out horizon) above
+    #: which fan-out counts as breaching; None disables the lag signal
+    sub_lag_windows_max: Optional[int] = None
+    #: consecutive breached/recovered intervals before the subs ladder
+    #: steps (conflate -> pause) or relaxes
+    sub_breach_intervals: int = 3
+    sub_recover_intervals: int = 5
 
 
 class _GraphControl:
@@ -487,7 +499,7 @@ class ControlPlane:
                  clock: Callable[[], float] = time.monotonic,
                  rng: Optional[Callable[[], float]] = None,
                  sampler: Optional[Callable[[float], Dict]] = None,
-                 failover=None, compactor=None, fleet=None):
+                 failover=None, compactor=None, fleet=None, subs=None):
         from reflow_tpu.obs import REGISTRY
         self.tier = tier
         #: optional serve.failover.FailoverCoordinator, stepped on the
@@ -504,6 +516,12 @@ class ControlPlane:
         #: the fleet plane is built on)
         self.fleet = fleet
         self._fleet_breached = False
+        #: optional subs.hub.SubscriptionHub: subscription fan-out is
+        #: the one read-side load the control plane actuates, because
+        #: it shares the replica process with the apply path — the
+        #: shedding ladder degrades push freshness (conflate, then
+        #: pause) before write-path SLOs breach
+        self.subs = subs
         self._compactor_restarts_used = 0
         self._compactor_failed = False
         self._compactor_booted = False
@@ -535,6 +553,11 @@ class ControlPlane:
         self.actions: Deque[Dict] = deque(maxlen=1024)
         self._stop_ev = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._sub_ladder = (BrownoutLadder(
+            "normal", ("conflate", "pause"),
+            breach_intervals=self.config.sub_breach_intervals,
+            recover_intervals=self.config.sub_recover_intervals)
+            if subs is not None else None)
         reg = self.registry
         self._c = {k: reg.counter(f"control.{k}") for k in (
             "ticks", "brownouts_entered", "brownouts_exited",
@@ -543,7 +566,8 @@ class ControlPlane:
             "committer_restarts", "scale_ups", "scale_downs",
             "reclaims", "floor_restores", "errors",
             "compactions", "compactor_restarts",
-            "fleet_lag_breaches")}
+            "fleet_lag_breaches", "sub_shed_steps",
+            "sub_shed_recovers")}
         reg.gauge("pool.live_workers", lambda: self.tier.live_workers)
         reg.gauge("control.interval_s", lambda: self.config.interval_s)
 
@@ -595,6 +619,12 @@ class ControlPlane:
         ctl = self._ctl.get(name)
         return "closed" if ctl is None else ctl.breaker.state
 
+    @property
+    def sub_shed_level(self) -> int:
+        """Current subscription shedding rung (0 normal, 1 conflate,
+        2 pause); 0 when no hub is attached."""
+        return 0 if self._sub_ladder is None else self._sub_ladder.level
+
     # -- the control loop --------------------------------------------------
 
     def step(self, now: Optional[float] = None) -> List[Dict]:
@@ -633,6 +663,8 @@ class ControlPlane:
             self._step_compactor(now, actions)
         if self.fleet is not None:
             self._step_fleet(now, actions)
+        if self.subs is not None:
+            self._step_subs(now, actions)
         for a in actions:
             self._record(a)
         return actions
@@ -663,6 +695,44 @@ class ControlPlane:
             actions.append({"now": now, "kind": "fleet_lag_recovered",
                             "advisory": True, "lag_spread": spread})
         self._fleet_breached = breached
+
+    def _step_subs(self, now: float, actions: List[Dict]) -> None:
+        """Drive the subscription shedding ladder off the hub's own
+        load signals (work-queue backlog, slowest-subscriber lag).
+        Unlike the fleet hook this one actuates: fan-out shares the
+        replica process with the apply path, so degrading push
+        freshness — conflate (level 1), then pause (level 2) — is how
+        write-path SLOs stay whole under subscriber overload. The
+        ladder's hysteresis (breach/recover streaks) keeps it from
+        flapping on one bursty window."""
+        cfg = self.config
+        if (cfg.sub_backlog_windows_max is None
+                and cfg.sub_lag_windows_max is None):
+            return
+        try:
+            load = self.subs.load()
+        except Exception:  # noqa: BLE001 - a closing hub must not kill the control loop; next interval re-reads
+            return
+        backlog = load.get("backlog_windows") or 0
+        lag = load.get("slowest_lag")
+        breached = (
+            (cfg.sub_backlog_windows_max is not None
+             and backlog > cfg.sub_backlog_windows_max)
+            or (cfg.sub_lag_windows_max is not None and lag is not None
+                and lag > cfg.sub_lag_windows_max))
+        before = self._sub_ladder.level
+        moved = self._sub_ladder.observe(breached)
+        if moved is None:
+            return
+        level = self._sub_ladder.level
+        self.subs.set_shed_level(level)
+        kind = "sub_shed_step" if level > before else "sub_shed_recover"
+        self._c["sub_shed_steps" if level > before
+                else "sub_shed_recovers"].inc()
+        actions.append({"now": now, "kind": kind, "level": level,
+                        "mode": moved, "backlog_windows": backlog,
+                        "slowest_lag": lag,
+                        "active_subs": load.get("active")})
 
     def _step_compactor(self, now: float, actions: List[Dict]) -> None:
         """Supervise the background WAL compactor: surface completed

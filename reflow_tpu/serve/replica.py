@@ -126,6 +126,8 @@ class ReplicaScheduler:
         self.bootstraps = 0
         self.restored_from: Optional[str] = None
         self._metric_names: List[Tuple[object, str]] = []
+        #: optional SubscriptionHub fed by _apply_staged (attach_hub)
+        self._hub = None
         self._restore()
 
     # -- transport surface (the watermark handshake) -----------------------
@@ -135,6 +137,18 @@ class ReplicaScheduler:
         or None for a fresh replica (the shipper then bootstraps)."""
         with self._lock:
             return tuple(self._cursor) if self._cursor is not None else None
+
+    def attach_hub(self, hub) -> None:
+        """Wire a :class:`~reflow_tpu.subs.hub.SubscriptionHub` into the
+        apply path: each applied commit window is handed off as
+        ``hub.on_window(from_h, to_h, tick_results)`` (O(1), the hub's
+        own thread does the fan-out) and non-monotonic state moves
+        (bootstrap/promote/reanchor) call ``hub.rebase()``. Pass None
+        to detach."""
+        with self._lock:
+            self._hub = hub
+        if hub is not None:
+            hub.rebase()   # start from a fresh snapshot of current state
 
     def bootstrap(self, ckpt_dir: str) -> Tuple[int, int]:
         """Checkpoint-anchored catch-up: load the *leader's* checkpoint
@@ -157,6 +171,8 @@ class ReplicaScheduler:
             self._snapshots = {}
             self.bootstraps += 1
         self.checkpoint()
+        if self._hub is not None:
+            self._hub.rebase()   # state moved non-monotonically
         return tuple(self._cursor)
 
     def receive(self, sh: Shipment):
@@ -264,6 +280,8 @@ class ReplicaScheduler:
             return 0
         window = self._staged[:last + 1]
         del self._staged[:last + 1]
+        hist0 = len(self.sched.history)
+        from_h = self._horizon
         _rep, _ded, ticks, _skip = replay_records(
             self.sched, [(p, r) for p, _e, r in window])
         self.records_applied += len(window)
@@ -271,6 +289,16 @@ class ReplicaScheduler:
         self._applied = window[-1][1]
         self._horizon = self.sched._tick
         self._snapshots = {}
+        hub = self._hub
+        if hub is not None and self._horizon > from_h:
+            results = tuple(self.sched.history[hist0:])
+            if len(results) == self._horizon - from_h:
+                # O(1) hand-off: the hub's fan-out thread does the work
+                hub.on_window(from_h, self._horizon, results)
+            else:
+                # replay didn't tick one-for-one (restored state or a
+                # trimmed history) — deltas can't be trusted; re-snapshot
+                hub.rebase()
         return len(window)
 
     # -- persistence -------------------------------------------------------
@@ -520,6 +548,8 @@ class ReplicaScheduler:
                              "replayed_pushes": report.replayed_pushes,
                              "replayed_ticks": report.replayed_ticks,
                              "final_tick": report.final_tick})
+        if self._hub is not None:
+            self._hub.rebase()   # subscribers re-snapshot off the leader
         return sched
 
     def reanchor(self, epoch: int) -> Optional[Tuple[int, int]]:
@@ -537,8 +567,11 @@ class ReplicaScheduler:
             if epoch > self._epoch:
                 self._epoch = epoch
             self._persist_cursor()
-            return tuple(self._cursor) if self._cursor is not None \
+            cursor = tuple(self._cursor) if self._cursor is not None \
                 else None
+        if self._hub is not None:
+            self._hub.rebase()   # holdback dropped; re-prove via snapshot
+        return cursor
 
     # -- lifecycle / observability -----------------------------------------
 

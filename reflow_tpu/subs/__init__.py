@@ -1,0 +1,19 @@
+"""Reactive reads: push-based standing queries with delta fan-out.
+
+The incremental dataflow computes exactly what changed every commit
+window; this package stops throwing that away. Clients register
+standing queries (``view`` / ``lookup`` / ``topk``) against a replica
+and receive only the per-query delta per window — over the wire or
+in-process — with a one-integer cursor making reconnect resume
+gap-free and duplicate-free. See docs/guide.md "Reactive reads".
+"""
+
+from reflow_tpu.subs.client import Subscriber
+from reflow_tpu.subs.hub import SubHandle, SubscriptionHub
+from reflow_tpu.subs.query import (DeltaFrame, QueryState, StandingQuery,
+                                   canon_query, merge_frames)
+from reflow_tpu.subs.wire import SubAck, SubscribeReq, SubscriptionServer
+
+__all__ = ["Subscriber", "SubHandle", "SubscriptionHub", "DeltaFrame",
+           "QueryState", "StandingQuery", "canon_query", "merge_frames",
+           "SubAck", "SubscribeReq", "SubscriptionServer"]

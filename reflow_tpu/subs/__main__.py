@@ -1,0 +1,10 @@
+"""``python -m reflow_tpu.subs`` — see :mod:`reflow_tpu.subs.cli`."""
+
+from __future__ import annotations
+
+import sys
+
+from reflow_tpu.subs.cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -361,6 +361,41 @@ declare("REFLOW_BENCH_MULTIPROC_RUN_S", "float", None,
         "multiproc bench per-phase write window seconds "
         "(default 1.5, smoke 0.6)")
 
+# -- reactive reads ('Reactive reads') --------------------------------------
+
+declare("REFLOW_SUB_OUTBOX", "int", 64,
+        "per-subscriber outbox bound (frames); overflow conflates the "
+        "backlog into one merged frame, and a backlog too large even "
+        "to conflate sheds the subscriber to snapshot semantics")
+declare("REFLOW_SUB_CONFLATE_MAX_ROWS", "int", 65536,
+        "row bound on a conflated frame; beyond it the subscriber is "
+        "shed (outbox cleared, fresh snapshot on the next round)")
+declare("REFLOW_SUB_IDLE_POLL_S", "float", 0.05,
+        "fan-out thread idle wakeup — the latency floor for reaping "
+        "expired subscribers when no windows arrive")
+declare("REFLOW_SUB_EXPIRE_S", "float", 30.0,
+        "wire subscriptions not polled for this long are reaped (a "
+        "reconnecting client re-registers and resumes by cursor)")
+declare("REFLOW_SUB_POLL_WAIT_S", "float", 0.2,
+        "server-side cap on one subscription long-poll's wait for "
+        "frames (clients long-poll in slices of this)")
+declare("REFLOW_SUB_MAX_FRAMES", "int", 256,
+        "max frames returned by one subscription poll")
+declare("REFLOW_SUB_IO_TIMEOUT_S", "float", 5.0,
+        "per-operation send/recv timeout on subscription "
+        "connections (Subscriber <-> SubscriptionServer)")
+declare("REFLOW_BENCH_SUBS", "flag", False,
+        "bench mode: reactive reads — one replica fans deltas to "
+        "100k simulated subscribers under 16-producer write load; "
+        "write-path p99 overhead, exact delta-vs-pull parity, "
+        "partition/heal resume with zero gaps and zero duplicates")
+declare("REFLOW_BENCH_SUBS_N", "int", None,
+        "subs bench simulated subscriber count "
+        "(default 100_000, smoke 2000)")
+declare("REFLOW_BENCH_SUBS_RUN_S", "float", None,
+        "subs bench per-leg write window seconds "
+        "(default 2.0, smoke 0.6)")
+
 
 # -- the config dataclass ---------------------------------------------------
 

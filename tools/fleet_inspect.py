@@ -115,6 +115,13 @@ def _print_fleet(snap: dict) -> None:
     debt = g.get("compact_debt_bytes")
     if debt is not None:
         print(f"  compaction debt: {int(debt)} byte(s)")
+    if g.get("subs_active") is not None:
+        rows = g.get("sub_rows_s")
+        lag = g.get("sub_lag_windows")
+        print(f"  subs: {int(g['subs_active'])} active   fan-out: "
+              f"{'n/a' if rows is None else f'{rows:.1f}'} row/s   "
+              f"slowest lag: "
+              f"{'n/a' if lag is None else int(lag)} window(s)")
     hdr = (f"  {'node':<16} {'horizon':>8} {'lag':>5} {'qps':>8} "
            f"{'epoch':>6} {'age_s':>7}  state")
     print(hdr)

@@ -1,0 +1,28 @@
+#!/usr/bin/env python3
+"""Checkout-relative wrapper for ``python -m reflow_tpu.subs``.
+
+Usage::
+
+    python tools/reflow_sub.py --connect HOST:PORT --sink counts \\
+        --kind topk --k 5
+    python tools/reflow_sub.py --connect HOST:PORT --sink counts \\
+        --kind lookup --key the,2 --json
+
+Tails one standing query against a replica's subscription endpoint
+(docs/guide.md "Reactive reads"): one line per applied commit window,
+human by default, ``reflow.sub/1`` JSON documents with ``--json``.
+The wrapper exists so an operator inside a checkout gets the
+identical entrypoint without installing the package.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from reflow_tpu.subs.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

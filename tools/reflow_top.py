@@ -50,6 +50,14 @@ def render(snap: dict, *, stale_link: bool = False) -> str:
         f"{'agree' if g.get('epoch_agree') else 'DISAGREE'} | "
         f"read qps {('n/a' if qps is None else qps)} | "
         f"compact debt {('n/a' if debt is None else int(debt))} B")
+    if g.get("subs_active") is not None:
+        srows = g.get("sub_rows_s")
+        slag = g.get("sub_lag_windows")
+        lines.append(
+            f"subs {int(g['subs_active'])} active | fan-out "
+            f"{('n/a' if srows is None else f'{srows:.1f}')} row/s | "
+            f"slowest lag "
+            f"{('n/a' if slag is None else int(slag))} window(s)")
     lines.append(f"{'NODE':<16} {'HORIZON':>8} {'LAG':>5} {'QPS':>8} "
                  f"{'EPOCH':>6} {'AGE':>7} LINKS")
     for name, e in sorted(nodes.items()):
@@ -73,6 +81,17 @@ def render(snap: dict, *, stale_link: bool = False) -> str:
         if brown:
             levels = ", ".join(f"{k}={v}" for k, v in sorted(brown.items()))
             lines.append(f"{'':<16} brownout: {levels}")
+        if e.get("subs_active") is not None:
+            srows = e.get("sub_rows_s")
+            slag = e.get("sub_lag_windows")
+            sconf = e.get("sub_conflations")
+            lines.append(
+                f"{'':<16} subs: {int(e['subs_active'])} active, "
+                f"{('n/a' if srows is None else f'{srows:.1f}')} row/s, "
+                f"conflated "
+                f"{('n/a' if sconf is None else int(sconf))}, "
+                f"lag "
+                f"{('n/a' if slag is None else int(slag))} window(s)")
     for line in snap.get("alerts", []):
         lines.append(f"ALERT: {line}")
     return "\n".join(lines)

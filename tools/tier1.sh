@@ -30,11 +30,11 @@ rc=${PIPESTATUS[0]}
 dots=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 echo DOTS_PASSED=$dots
 
-# regression floor: the suite passed 395 at the PR-11 baseline (380 at
-# PR 10, 333 at PR 8, 315 at PR 6); a run below the previous baseline
-# means previously-green tests broke (or silently vanished), even if
-# pytest's own exit status reads clean.
-FLOOR=${TIER1_FLOOR:-395}
+# regression floor: the suite passed 533 at the PR-18 baseline (395 at
+# PR 11, 380 at PR 10, 333 at PR 8, 315 at PR 6); a run below the
+# previous baseline means previously-green tests broke (or silently
+# vanished), even if pytest's own exit status reads clean.
+FLOOR=${TIER1_FLOOR:-520}
 if [ "$dots" -lt "$FLOOR" ]; then
   echo "TIER1: DOTS_PASSED=$dots below floor $FLOOR"
   rc=4
@@ -437,6 +437,35 @@ print(f"TIER1 multiproc smoke: {r['replicas']} replica + "
       f"{r['leader_tick']}; {r['reconnects_total']} reconnect(s), "
       f"{r['resubmits_total']} resubmit(s), {r['deduped_total']} "
       f"deduped")
+EOF
+fi
+
+# optional (RUN_BENCH=1): the subs smoke — reactive reads: one
+# replica's SubscriptionHub fanning per-window deltas to simulated
+# subscribers (plus real wire subscribers through a mid-run
+# partition + heal of their endpoint) under sustained 16-producer
+# writes: exact push-vs-pull parity at equal horizons, zero gaps and
+# zero duplicate applies on resume, and the write path's admission
+# p99 within 2x the no-subscriber baseline (with the bench's
+# documented absolute floor so shared CI cores can't turn scheduler
+# jitter into a spurious fail).
+if [ "${RUN_BENCH:-0}" = "1" ] && [ $rc -eq 0 ]; then
+  REFLOW_BENCH_SUBS=1 REFLOW_BENCH_SMOKE=1 JAX_PLATFORMS=cpu \
+    timeout -k 10 300 python bench.py --json-out /tmp/_t1_subs.json \
+    > /dev/null || rc=3
+  python - <<'EOF' || rc=3
+import json
+r = json.load(open("/tmp/_t1_subs.json"))
+assert r["schema"] == "reflow.bench/1" and r["mode"] == "subs", r
+assert r["subs"]["parity_max_abs_diff"] == 0, r
+assert r["write_p99_bounded"], r
+assert r["subs"]["active_subs"] >= r["subscribers"], r
+assert r["subs"]["wire_reconnects"] >= r["wire_subscribers"], r
+print(f"TIER1 subs smoke: {r['subscribers']} subscribers, "
+      f"{r['subs']['fanout_rows_per_s']} fan-out rows/s, write p99 "
+      f"{r['write_p99_overhead_x']}x baseline (bounded), parity "
+      f"exact, {r['subs']['wire_reconnects']} wire reconnect(s) "
+      f"gap-free")
 EOF
 fi
 exit $rc
