@@ -3216,6 +3216,389 @@ def run_multiproc_bench() -> dict:
     return out
 
 
+# -- end-to-end tracing mode (REFLOW_BENCH_E2ETRACE=1) ---------------------
+
+def run_e2etrace_bench() -> dict:
+    """Follow-the-write under chaos (docs/guide.md "End-to-end tracing
+    & flight recorder"): the multi-process topology — a leader + 2
+    replica + N producer *processes* over the ingestion RPC, live wire
+    subscribers pumped in the parent — with tracing AND flight
+    recorders on in every child, then kill -9 of a replica and of the
+    leader mid-run (cross-process promotion, producers and subscribers
+    retargeted).
+
+    Hard asserts, all structural:
+
+    - **full chains** — merging every clean-exit child's exported
+      trace plus the parent's own onto one timeline
+      (``trace_inspect`` multi-file, ``baseTimeS``-anchored), at least
+      one sampled write's causal group carries all nine links
+      ``producer_submit -> rpc_admit -> admission -> wal_append ->
+      ship_segment -> net_send -> replica_replay -> sub_fanout ->
+      sub_deliver``, and at least one ``producer_submit`` was minted
+      in the post-promotion epoch (the chain survived the failover);
+    - **freshness tiles** — the ack->deliver decomposition of the
+      full chains sums to their end-to-end latency within 10%;
+    - **flight recordings survive kill -9** — the dead leader's disk
+      corner (and the killed replica's archived ``.prev`` incarnation)
+      merge via ``tools/reflow_flight`` into a timeline that carries
+      the failover evidence, even though those processes never flushed
+      a trace export;
+    - **wire compat** — with tracing off, ``SubmitReq`` /
+      ``SubmitAck`` / ``DeltaFrame`` wire forms pickle byte-identically
+      to the pre-trace protocol (the trailing-``cause`` trim).
+
+    Host-side CPU work; children run with ``JAX_PLATFORMS=cpu``.
+    """
+    import importlib.util
+    import pickle
+    import shutil
+    import tempfile
+    import threading
+
+    from reflow_tpu import obs
+    from reflow_tpu.net.transport import TcpTransport
+    from reflow_tpu.proc import ProcHarness
+    from reflow_tpu.proc.harness import ControlClient
+    from reflow_tpu.serve.rpc import SubmitAck, SubmitReq, _trim
+    from reflow_tpu.subs.client import Subscriber
+    from reflow_tpu.subs.query import DeltaFrame, frames_to_wire
+    from reflow_tpu.workloads import wordcount
+
+    smoke = env_flag("REFLOW_BENCH_SMOKE")
+    n_replicas = 2
+    n_prod = max(1, env_int("REFLOW_BENCH_E2ETRACE_PRODUCERS",
+                            "4" if smoke else "16"))
+    run_s = env_float("REFLOW_BENCH_E2ETRACE_RUN_S",
+                      "0.6" if smoke else "1.5")
+    n_procs = 2 + n_replicas + n_prod  # + the parent pumping subs
+    pace_s = 0.02 if (os.cpu_count() or 1) < n_procs else 0.0
+    out = {"replicas": n_replicas, "producers": n_prod, "run_s": run_s,
+           "producer_pace_s": pace_s}
+
+    # -- wire compat: tracing-off frames byte-identical -----------------
+    # (in-process, before the parent enables tracing: the trim must
+    # reduce unstamped requests/acks/frames to the exact pre-trace
+    # pickle bytes, and a stamped frame must still parse one-sided)
+    req = SubmitReq("b-0", "words", ("payload",), 5.0)
+    assert pickle.dumps(_trim(tuple(req))) == \
+        pickle.dumps(("b-0", "words", ("payload",), 5.0))
+    ack = SubmitAck("b-0", "applied", ("r",), None)
+    assert pickle.dumps(_trim(tuple(ack))) == \
+        pickle.dumps(("b-0", "applied", ("r",), None))
+    frame = DeltaFrame(0, 4, "view", ((("k", "v"), 1),), False)
+    assert pickle.dumps(frames_to_wire([frame])) == \
+        pickle.dumps(((0, 4, "view", ((("k", "v"), 1),), False),))
+    stamped = DeltaFrame(0, 4, "view", (), False, ("n#0#1",))
+    assert frames_to_wire([stamped])[0][-1] == ("n#0#1",)
+    out["wire_compat_identical"] = True
+
+    root = tempfile.mkdtemp(prefix="reflow-e2etrace-")
+    keep_dir = os.path.join(tempfile.gettempdir(),
+                            "reflow_e2etrace_traces")
+    child_env = {"JAX_PLATFORMS": "cpu", "REFLOW_TRACE": "1",
+                 "REFLOW_FLIGHT": "1"}
+    h = ProcHarness(root, child_env=child_env)
+    obs.trace.reset()
+    obs.enable()  # the parent records sub_deliver — the chain's last link
+    subs: dict = {}
+    pumpers: list = []
+    stop_pump = threading.Event()
+    g, src, sink = wordcount.build_graph()
+    try:
+        h.spawn_leader(fsync="tick", epoch=0)
+        rnames = [f"r{i}" for i in range(n_replicas)]
+        for nm in rnames:
+            h.spawn_replica(nm)
+        h.attach_replicas()
+        for i in range(n_prod):
+            h.spawn_producer(f"p{i}", index=i, pace_s=pace_s)
+
+        # live subscribers in the parent, one per replica, pumped from
+        # background threads for the whole run (kills included)
+        for nm in rnames:
+            sub = Subscriber(TcpTransport(),
+                             tuple(h.child(nm).ready["subs"]),
+                             sink.name, kind="view", name=f"sub-{nm}")
+            subs[nm] = sub
+
+            def pump(sub=sub):
+                while not stop_pump.is_set():
+                    sub.pump(wait_s=0.1)
+
+            t = threading.Thread(target=pump, name=f"pump/{nm}",
+                                 daemon=True)
+            t.start()
+            pumpers.append(t)
+        log("e2etrace: fleet up, load running")
+        time.sleep(run_s)
+
+        # -- kill -9 a replica mid-run: its flight ring survives on
+        # disk; the respawn archives it as the .prev generation -------
+        h.kill9(rnames[0])
+        time.sleep(0.1)
+        h.respawn(rnames[0])
+        h.attach_replicas([rnames[0]])
+        h.barrier(timeout_s=60.0)
+        subs[rnames[0]].retarget(
+            tuple(h.child(rnames[0]).ready["subs"]))
+        log("e2etrace: replica kill/respawn healed")
+        time.sleep(run_s / 2)
+
+        # -- then the leader: cross-process failover ------------------
+        coord = h.coordinator(epoch=0, confirm_intervals=2,
+                              drain_timeout_s=10.0)
+        h.kill9("leader")
+        t_kill = time.monotonic()
+        promote_evt = None
+        now = 0.0
+        while promote_evt is None and time.monotonic() - t_kill < 60.0:
+            for e in coord.step(now):
+                if e.get("kind") == "failover_promote":
+                    promote_evt = e
+            now += 1.0
+            time.sleep(0.02)
+        assert promote_evt is not None, "leader death never promoted"
+        out["promotion_s"] = time.monotonic() - t_kill
+        out["winner"] = promote_evt["winner"]
+        out["epoch"] = promote_evt["epoch"]
+        assert out["epoch"] == 1
+        winner = out["winner"]
+        log(f"e2etrace: promoted {winner} in {out['promotion_s']:.1f}s")
+        survivors = [nm for nm in rnames if nm != winner]
+        # the winner now serves ingestion; keep its subscriber on a
+        # replica that still replays shipped windows
+        subs[winner].retarget(
+            tuple(h.child(survivors[0]).ready["subs"]))
+        time.sleep(run_s)  # post-promotion writes: epoch-1 chains
+
+        # -- quiesce + drain the last deltas to the subscribers -------
+        prod_exits = []
+        for i in range(n_prod):
+            st = h.child(f"p{i}").stop()
+            assert st is not None and st.get("ok"), \
+                f"producer p{i} died dirty: {st!r}"
+            assert st["in_doubt"] == [], \
+                f"{st['name']} exited in doubt: {st['in_doubt']}"
+            prod_exits.append(st)
+        out["reconnects_total"] = sum(s["reconnects"]
+                                      for s in prod_exits)
+        log("e2etrace: producers stopped; draining")
+
+        # -- deterministically mint a sampled write in the NEW epoch --
+        # in-doubt resubmits keep their epoch-0 tokens, and on a 1-CPU
+        # box the paced producers may never draw a 1-in-N sample inside
+        # the short post-promotion window — so the parent probes the
+        # promoted leader until one token carries epoch 1 (at most
+        # ~2*SAMPLE_EVERY submits: the first mint happens before the
+        # hello that learns the new epoch). Probing after the producer
+        # quiesce keeps it off the saturated admission queue.
+        from reflow_tpu.proc.worker import producer_batch_words
+        from reflow_tpu.serve import APPLIED, DEDUPED, RemoteProducer
+        probe = RemoteProducer(TcpTransport(), h.ingest_address,
+                               name="probe")
+        try:
+            probe_cause = None
+            t_probe0 = time.monotonic()
+            for i in range(2 * obs.trace.SAMPLE_EVERY + 2):
+                pbatch = wordcount.ingest_lines(
+                    [" ".join(producer_batch_words(97, i))])
+                ticket = probe.submit(src.name, pbatch, timeout=30.0)
+                while True:
+                    assert time.monotonic() - t_probe0 < 120.0, \
+                        f"probe submit never acked ({i} sent)"
+                    try:
+                        res = ticket.result(timeout=0.3)
+                    except TimeoutError:
+                        continue
+                    if res.status in (APPLIED, DEDUPED):
+                        break
+                    assert res.status != "rejected" or \
+                        "backpressure" in str(res.reason), \
+                        f"probe rejected: {res.reason}"
+                    # backpressure/SHED: same id, retry
+                    time.sleep(0.05)
+                    ticket = probe.submit(src.name, pbatch,
+                                          batch_id=ticket.batch_id,
+                                          timeout=30.0)
+                if ticket.cause is not None and "#1#" in ticket.cause:
+                    probe_cause = ticket.cause
+                    break
+            assert probe_cause is not None, \
+                "no probe token minted in the new epoch"
+            out["probe_cause"] = probe_cause
+            log(f"e2etrace: epoch-1 probe token {probe_cause}")
+        finally:
+            probe.close()
+
+        ingest = ControlClient(h.ingest_address, io_timeout_s=30.0)
+        ingest.call("flush", 20.0)
+        _, leader_tick, _view = ingest.call("view", sink.name)
+        out["leader_tick"] = leader_tick
+        h.barrier(names=survivors, min_horizon=leader_tick,
+                  timeout_s=30.0)
+        stop_pump.set()
+        for t in pumpers:
+            t.join(timeout=30)
+        for nm, sub in subs.items():
+            assert sub.wait_horizon(leader_tick, timeout_s=30.0), \
+                f"subscriber {nm} stalled at {sub.horizon}/{leader_tick}"
+            assert sub.gaps_total == 0, f"subscriber {nm} saw a gap"
+        out["sub_frames_applied"] = sum(
+            s.frames_applied_total for s in subs.values())
+
+        # -- fleet gauges: the new freshness/flight planes are visible
+        # from the aggregator (children ship REGISTRY snapshots) ------
+        deadline = time.monotonic() + 15.0
+        fleet_f50 = fleet_flight = None
+        while time.monotonic() < deadline:
+            snap = h.aggregator.fleet_snapshot()
+            fleet_f50 = snap["gauges"].get("subs.freshness_p50")
+            fleet_flight = snap["gauges"].get("flight.events_total")
+            if fleet_f50 is not None and fleet_flight is not None:
+                break
+            time.sleep(0.1)
+        out["fleet_freshness_p50"] = fleet_f50
+        out["fleet_flight_events"] = fleet_flight
+        assert fleet_f50 is not None, \
+            "subs.freshness_p50 never reached the fleet aggregator"
+        assert fleet_flight is not None and fleet_flight >= 1, \
+            "flight.events_total never reached the fleet aggregator"
+
+        for sub in subs.values():
+            sub.close()
+        h.close()  # clean exits: every child exports <root>/<name>/trace.json
+
+        # -- merge every process's trace onto one timeline ------------
+        parent_trace = os.path.join(root, "parent-trace.json")
+        obs.export_chrome_trace(parent_trace)
+        trace_files = [parent_trace]
+        for nm in h.children:
+            p = os.path.join(root, nm, "trace.json")
+            if os.path.exists(p):
+                trace_files.append(p)
+        # the killed leader never exported — by design; its story is
+        # the flight recording below
+        assert not os.path.exists(
+            os.path.join(root, "leader", "trace.json"))
+        out["trace_files_merged"] = len(trace_files)
+        assert len(trace_files) >= 2 + n_replicas + n_prod - 1
+
+        # keep the traces where the tier-1 smoke can re-check them —
+        # copied BEFORE the structural asserts so a failing run leaves
+        # its evidence behind
+        shutil.rmtree(keep_dir, ignore_errors=True)
+        os.makedirs(keep_dir, exist_ok=True)
+        kept = []
+        for p in trace_files:
+            dst = os.path.join(
+                keep_dir,
+                f"{os.path.basename(os.path.dirname(p))}-trace.json")
+            shutil.copyfile(p, dst)
+            kept.append(dst)
+        out["trace_files"] = kept
+
+        spec = importlib.util.spec_from_file_location(
+            "trace_inspect", os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "tools", "trace_inspect.py"))
+        ti = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(ti)
+        report = ti.inspect(trace_files,
+                            require_chain=list(ti.FULL_CHAIN))
+        causal = report["causal"]
+        assert causal is not None, "no causal tokens in any trace"
+        out["causal_groups"] = causal["groups"]
+        out["full_chains"] = causal["full_chains"]
+        out["required_chains"] = causal["required_chains"]
+        if causal["full_chains"] < 1:
+            # per-file cause-span inventory: WHICH process dropped its
+            # link tells you where the chain broke
+            per_file = {}
+            for p in trace_files:
+                evs, _ = ti.load_traces([p])
+                names = sorted({
+                    e["name"] for e in evs if e.get("ph") == "X"
+                    and ((e.get("args") or {}).get("cause")
+                         or (e.get("args") or {}).get("causes"))})
+                per_file[os.path.basename(os.path.dirname(p))] = names
+            raise AssertionError(
+                f"no full submit->deliver chain: {causal['span_names']} "
+                f"per-file: {per_file}")
+        assert causal["required_chains"] >= 1
+        fresh = report["freshness"]
+        assert fresh is not None
+        out["freshness_e2e_p50_us"] = fresh["e2e_p50_us"]
+        out["freshness_max_dev_frac"] = fresh["max_dev_frac"]
+        out["freshness_stages"] = {
+            s: fresh["stages"][s]["p50_us"]
+            for s in ti.FRESHNESS_STAGES}
+        assert fresh["max_dev_frac"] <= 0.10, \
+            f"freshness tiling off by {fresh['max_dev_frac']:.1%} " \
+            f"(worst chain: {fresh['worst']}; traces kept in {keep_dir})"
+        # at least one chain was minted AFTER the promotion: its token
+        # carries the new epoch (origin#1#seq)
+        events, _files = ti.load_traces(trace_files)
+        post_promo = sum(
+            1 for e in events
+            if e.get("ph") == "X" and e.get("name") == "producer_submit"
+            and "#1#" in str((e.get("args") or {}).get("cause", "")))
+        out["post_promotion_submits"] = post_promo
+        assert post_promo >= 1, "no sampled write in the new epoch"
+        log(f"e2etrace: {causal['full_chains']} full chain(s) across "
+            f"{len(trace_files)} trace file(s), freshness e2e p50 "
+            f"{fresh['e2e_p50_us']:.0f}us (tiling dev "
+            f"{100 * fresh['max_dev_frac']:.2f}%), {post_promo} "
+            f"post-promotion sampled submit(s)")
+
+        # -- post-mortem: the killed processes' flight recordings ------
+        spec = importlib.util.spec_from_file_location(
+            "reflow_flight", os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "tools", "reflow_flight.py"))
+        rf = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(rf)
+        flight = rf.merge([root])
+        out["flight_nodes"] = sorted(flight["nodes"])
+        assert "leader" in flight["nodes"] \
+            and flight["nodes"]["leader"]["events"] >= 1, \
+            "the kill -9'd leader left no flight recording"
+        # the killed replica's dead incarnation survives as .prev
+        # beside its respawn's live ring: two distinct pids recorded
+        # under one corner (a short run may never flip a->b, so file
+        # count alone proves less than recovered-pid count)
+        assert len(flight["nodes"][rnames[0]]["pids"]) >= 2 and \
+            flight["nodes"][rnames[0]]["files"] >= 2, \
+            flight["nodes"][rnames[0]]
+        assert any(ev["name"] in ("failover_elect", "failover_replay")
+                   for ev in flight["events"]), \
+            "no failover evidence in the merged flight timeline"
+        out["flight_events_total"] = len(flight["events"])
+        log(f"e2etrace: flight recordings from "
+            f"{len(flight['nodes'])} node(s) "
+            f"({out['flight_events_total']} event(s)) — killed "
+            f"leader + {rnames[0]}'s .prev incarnation recovered")
+
+        flight_path = os.path.join(keep_dir, "flight_merged.json")
+        with open(flight_path, "w") as f:
+            json.dump(flight, f, indent=2, sort_keys=True)
+        out["flight_merged_file"] = flight_path
+        out["kills"] = h.kills
+        out["respawns"] = h.respawns
+    finally:
+        stop_pump.set()
+        for sub in subs.values():
+            try:
+                sub.close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        h.close()
+        obs.disable()
+        obs.trace.reset()
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 # -- tier / multi-graph serving mode (REFLOW_BENCH_TIER=1) -----------------
 
 def run_tier_bench() -> dict:
@@ -4357,6 +4740,19 @@ def main() -> None:
             "unit": "s",
             **out,
         }, json_out, mode="multiproc")
+        return
+
+    if env_flag("REFLOW_BENCH_E2ETRACE"):
+        # e2etrace mode spawns its own CPU-pinned children; the parent
+        # pumps subscribers and merges traces — no tunnel
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        out = run_e2etrace_bench()
+        _emit({
+            "metric": "e2etrace_full_chains",
+            "value": out["full_chains"],
+            "unit": "chains",
+            **out,
+        }, json_out, mode="e2etrace")
         return
 
     if env_flag("REFLOW_BENCH_OBS"):

@@ -160,7 +160,7 @@ def run(root: str, *, passes: Optional[List[str]] = None,
     # reflow_tpu.analysis.core` alone stays side-effect-light
     from reflow_tpu.analysis import (constants, envknobs,  # noqa: F401
                                      exceptions, locks, metrics_pass,
-                                     seams, sockets)
+                                     seams, sockets, spans)
 
     corpus = Corpus(root)
     findings: List[Finding] = []

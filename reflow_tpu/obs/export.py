@@ -36,6 +36,13 @@ def chrome_events() -> List[Dict[str, Any]]:
     are ``n - cap``); readers must treat such tracks as incomplete
     rather than assuming the window starts at the first surviving
     event."""
+    return _snapshot()[0]
+
+
+def _snapshot() -> tuple:
+    """``(chrome_events, base_time_s)`` from one ring snapshot — the
+    base is computed from the same events, so ``baseTimeS`` in the
+    exported file can never drift from the ``ts`` values."""
     with trace._rings_lock:
         rings = list(trace._rings)
     raw = []
@@ -47,7 +54,7 @@ def chrome_events() -> List[Dict[str, Any]]:
         for ev in r.events():
             raw.append((r.track, ev))
     if not raw:
-        return []
+        return [], 0.0
     base = min(ev[1] for _t, ev in raw)
     tids: Dict[str, int] = {}
     for t in dropped:
@@ -74,17 +81,29 @@ def chrome_events() -> List[Dict[str, Any]]:
         meta.append({"ph": "M", "name": "dropped_events", "pid": 1,
                      "tid": tids[t], "args": {"track": t,
                                               "count": count}})
-    return meta + spans
+    return meta + spans, base
 
 
 def export_chrome_trace(path: Optional[str] = None) -> str:
     """Write the chrome trace JSON; returns the path written
-    (``REFLOW_TRACE_OUT`` or ``reflow_trace.json`` by default)."""
+    (``REFLOW_TRACE_OUT`` or ``reflow_trace.json`` by default).
+
+    Besides the standard ``traceEvents``, the file carries two
+    top-level keys that make multi-process merging possible:
+    ``baseTimeS`` — the ``perf_counter()`` value every ``ts`` is
+    relative to (processes on one host share the monotonic clock, so
+    ``baseTimeS + ts/1e6`` is directly comparable across files) — and
+    ``node`` — this process's fleet node id. Chrome/Perfetto ignore
+    unknown top-level keys, so the file stays viewer-compatible."""
+    from reflow_tpu.obs.wire import node_id
     from reflow_tpu.utils.config import env_str
     path = path or env_str("REFLOW_TRACE_OUT")
+    events, base = _snapshot()
     with open(path, "w") as f:
-        json.dump({"traceEvents": chrome_events(),
-                   "displayTimeUnit": "ms"}, f)
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ms",
+                   "baseTimeS": base,
+                   "node": node_id()}, f)
     return path
 
 

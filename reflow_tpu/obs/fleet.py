@@ -257,6 +257,10 @@ class FleetAggregator:
             "sub_rows_s": self._sub_rows_s(ring),
             "sub_conflations": self._sub_conflations(gauges),
             "sub_lag_windows": _num(gauges.get("subs.slowest_lag")),
+            # None on snapshots from pre-upgrade nodes (gauge absent)
+            "sub_freshness_p50": _num(gauges.get("subs.freshness_p50")),
+            "sub_freshness_p99": _num(gauges.get("subs.freshness_p99")),
+            "flight_events": _num(gauges.get("flight.events_total")),
         }
         brownout = {k: v for k, v in gauges.items() if "brownout" in k}
         if brownout:
@@ -345,6 +349,12 @@ class FleetAggregator:
                     if e["sub_rows_s"] is not None]
         sub_lag = [e["sub_lag_windows"] for e in nodes.values()
                    if e["sub_lag_windows"] is not None]
+        sub_f50 = [e["sub_freshness_p50"] for e in nodes.values()
+                   if e["sub_freshness_p50"] is not None]
+        sub_f99 = [e["sub_freshness_p99"] for e in nodes.values()
+                   if e["sub_freshness_p99"] is not None]
+        flight_ev = [e["flight_events"] for e in nodes.values()
+                     if e["flight_events"] is not None]
         link_states: Dict[str, int] = {}
         for e in nodes.values():
             for state in e["conn_states"].values():
@@ -364,6 +374,14 @@ class FleetAggregator:
             "subs_active": int(sum(subs)) if subs else None,
             "sub_rows_s": round(sum(sub_rows), 3) if sub_rows else None,
             "sub_lag_windows": max(sub_lag) if sub_lag else None,
+            # worst push freshness across the fleet (seconds); None
+            # until some node ships the gauge (pre-upgrade snapshots)
+            "subs.freshness_p50": (round(max(sub_f50), 6)
+                                   if sub_f50 else None),
+            "subs.freshness_p99": (round(max(sub_f99), 6)
+                                   if sub_f99 else None),
+            "flight.events_total": (int(sum(flight_ev))
+                                    if flight_ev else None),
             "link_states": link_states,
             "max_age_s": round(max(
                 (e["age_s"] for e in nodes.values()), default=0.0), 4),

@@ -43,8 +43,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["ENABLED", "RING_CAPACITY", "SAMPLE_EVERY", "STAGES",
            "TraceCtx", "enable", "disable", "enabled", "reset", "evt",
-           "mint", "mint_cause", "ticket_stages", "wal_accum_reset",
-           "wal_accum_add", "wal_accum_take"]
+           "mint", "mint_cause", "sample", "set_flight_hook",
+           "ticket_stages", "wal_accum_reset", "wal_accum_add",
+           "wal_accum_take"]
 
 #: hot-path gate — read directly (``if trace.ENABLED:``) at every
 #: instrumentation site; never wrapped in a function call
@@ -70,6 +71,19 @@ _tls = threading.local()
 _gen = 0
 _mint_n = itertools.count()
 _cause_n = itertools.count()
+
+#: optional flight-recorder tee (obs/flight.py installs it): called as
+#: ``hook(name, ts, dur, track, args)`` after every ring put. A plain
+#: module global (like ENABLED) so the disabled cost is one None check.
+_flight_hook = None
+
+
+def set_flight_hook(hook) -> None:
+    """Install (or clear, with None) the flight-recorder tee on
+    :func:`evt`. One consumer at a time — the per-process
+    :class:`~reflow_tpu.obs.flight.FlightRecorder`."""
+    global _flight_hook
+    _flight_hook = hook
 
 
 class TraceCtx:
@@ -160,12 +174,23 @@ def evt(name: str, ts: float, dur: float, track: Optional[str] = None,
     if not ENABLED:
         return
     _ring().put((name, ts, dur, track, args))
+    if _flight_hook is not None:
+        _flight_hook(name, ts, dur, track, args)
 
 
 def mint(batch_id: str, t0: float) -> TraceCtx:
     """Mint the trace context for one submission (call under ENABLED)."""
     return TraceCtx(batch_id, t0,
                     next(_mint_n) % SAMPLE_EVERY == 0)
+
+
+def sample() -> bool:
+    """One draw from the global 1-in-``SAMPLE_EVERY`` sampler — the
+    same counter :func:`mint` uses, for callers (the remote producer)
+    that decide sampling *before* a ticket exists. The decision then
+    rides the minted causality token over the wire so every downstream
+    process records the same writes without re-rolling."""
+    return next(_mint_n) % SAMPLE_EVERY == 0
 
 
 def mint_cause(origin: str, epoch: int) -> str:
@@ -202,9 +227,11 @@ def ticket_stages(ctx: TraceCtx, *, t_adm: float, t_ready: float,
              ("execute", t_exec0, t_exec1),
              ("fsync", t_exec1, d),
              ("resolve", d, t_res))
-    ring = _ring()
+    args: Dict[str, Any] = {"batch_id": ctx.batch_id}
+    if ctx.cause:
+        args["cause"] = ctx.cause
     for name, s, e in spans:
-        ring.put((name, s, e - s, track, {"batch_id": ctx.batch_id}))
+        evt(name, s, e - s, track=track, args=args)
 
 
 # -- WAL time accumulator (legacy) -------------------------------------------

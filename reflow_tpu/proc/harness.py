@@ -421,7 +421,10 @@ class ProcHarness:
             raise RuntimeError("no leader to connect the producer to")
         return self._spawn({
             "role": "producer", "name": name, "index": index,
-            "connect": tuple(connect), "pace": pace_s})
+            "connect": tuple(connect), "pace": pace_s,
+            # producers get a disk corner too: the flight recorder and
+            # exit-time trace export land there, same as server roles
+            "root": os.path.join(self.root, name)})
 
     # -- topology ------------------------------------------------------
 

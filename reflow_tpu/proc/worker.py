@@ -39,8 +39,11 @@ from reflow_tpu.net.client import RemoteFollower
 from reflow_tpu.net.framing import TransportError
 from reflow_tpu.net.server import ReplicaServer
 from reflow_tpu.net.transport import TcpTransport
+from reflow_tpu.obs import flight as _flight
+from reflow_tpu.obs import trace as _trace
 from reflow_tpu.obs.fleet import TelemetryShipper
 from reflow_tpu.obs.registry import REGISTRY
+from reflow_tpu.utils.config import env_flag, env_str
 from reflow_tpu.serve import (APPLIED, DEDUPED, IngestFrontend,
                               RemoteProducer, ReplicaScheduler,
                               RpcIngestServer)
@@ -104,6 +107,37 @@ def producer_batch_words(index: int, seq: int) -> List[str]:
     base = (index + 1) * 100003 + seq * 9176
     return [f"w{(base + i * 31) % _BATCH_VOCAB}"
             for i in range(_BATCH_WORDS)]
+
+
+def _obs_install(opts: dict, name: str):
+    """Per-child observability: when ``REFLOW_FLIGHT`` is set, install
+    the flight recorder in this node's disk corner
+    (``REFLOW_FLIGHT_DIR`` or ``<root>/flight``) — the bounded on-disk
+    recording a kill -9 leaves behind for ``tools/reflow_flight.py``."""
+    if not env_flag("REFLOW_FLIGHT"):
+        return None
+    directory = env_str("REFLOW_FLIGHT_DIR")
+    if not directory:
+        root = opts.get("root")
+        directory = os.path.join(root, "flight") if root else "flight"
+    rec = _flight.install(directory, node=name)
+    rec.publish_metrics(REGISTRY)
+    return rec
+
+
+def _obs_exit(opts: dict) -> None:
+    """Clean-exit observability: flush the flight ring and export this
+    child's trace rings to ``<root>/trace.json`` so the parent can
+    merge per-process traces post-run. Killed children never get here
+    — their evidence is the flight recording."""
+    _flight.flush_now()
+    if _trace.ENABLED and opts.get("root"):
+        try:
+            from reflow_tpu.obs.export import export_chrome_trace
+            export_chrome_trace(
+                os.path.join(opts["root"], "trace.json"))
+        except OSError:
+            pass
 
 
 def _telemetry(opts: dict, name: str) -> Optional[TelemetryShipper]:
@@ -248,6 +282,7 @@ def run_replica(opts: dict) -> dict:
                        host=opts.get("host", "127.0.0.1"),
                        workload=opts.get("workload", "wordcount"))
     node.start()
+    _obs_install(opts, opts["name"])
     telemetry = _telemetry(opts, opts["name"])
     emit({"event": "ready", "role": "replica", "name": node.name,
           "pid": os.getpid(), "addr": list(node.server.address),
@@ -262,6 +297,7 @@ def run_replica(opts: dict) -> dict:
         if telemetry is not None:
             telemetry.stop()
         node.close()
+        _obs_exit(opts)
     st = node.status()
     st.update({"event": "exit", "role": "replica", "ok": True})
     return st
@@ -289,6 +325,7 @@ def run_leader(opts: dict) -> dict:
                              leader_tick=lambda: sched._tick)
     shipper.publish_metrics(REGISTRY)
     shipper.start()
+    _obs_install(opts, name)
     telemetry = _telemetry(opts, name)
     emit({"event": "ready", "role": "leader", "name": name,
           "pid": os.getpid(), "ingest": list(ingest.address),
@@ -319,6 +356,7 @@ def run_leader(opts: dict) -> dict:
         ingest.close()
         if telemetry is not None:
             telemetry.stop()
+        _obs_exit(opts)
     wal = sched.wal
     return {"event": "exit", "role": "leader", "name": name, "ok": True,
             "tick": sched._tick, "lsn": wal.last_lsn(),
@@ -338,6 +376,7 @@ def run_producer(opts: dict) -> dict:
     src_name = opts.get("source", "words")
     prod = RemoteProducer(TcpTransport(), tuple(opts["connect"]),
                           name=name)
+    _obs_install(opts, name)
     telemetry = _telemetry(opts, name)
     emit({"event": "ready", "role": "producer", "name": name,
           "pid": os.getpid(), "connect": list(opts["connect"])})
@@ -399,6 +438,7 @@ def run_producer(opts: dict) -> dict:
         if telemetry is not None:
             telemetry.stop()
         prod.close()
+        _obs_exit(opts)
     return {"event": "exit", "role": "producer", "name": name,
             "ok": True, "index": index, "acked": acked,
             "submits": prod.submits_total,

@@ -866,6 +866,11 @@ class ControlPlane:
                 actions.append({"now": now, "kind": "breaker_open",
                                 "graph": name,
                                 "crashes": ctl.breaker.crashes})
+                # a breaker trip is a moment the process may not
+                # outlive — flush it to the flight ring eagerly
+                from reflow_tpu.obs import flight as _flight
+                _flight.note("breaker_open", graph=name,
+                             crashes=ctl.breaker.crashes)
         ctl.last_state = state
         if not cfg.respawn:
             return

@@ -253,7 +253,9 @@ class IngestFrontend:
     # -- producer side -----------------------------------------------------
 
     def submit(self, source: Node, batch, *, batch_id: Optional[str] = None,
-               timeout: Optional[float] = None, preimage=None) -> Ticket:
+               timeout: Optional[float] = None, preimage=None,
+               cause: Optional[str] = None,
+               sampled: Optional[bool] = None) -> Ticket:
         """Admit one micro-batch for ``source``; returns a Ticket that
         resolves once the batch's fate is decided. Thread-safe; callable
         from any number of producers. ``timeout`` bounds a ``block``
@@ -262,7 +264,14 @@ class IngestFrontend:
         ``preimage``: for a device-resident ``batch``, the host-side
         ``DeltaBatch`` it was uploaded from — a durable scheduler then
         logs these bytes instead of reading the device copy back (the
-        zero-readback logging path). Ignored for host batches."""
+        zero-readback logging path). Ignored for host batches.
+
+        ``cause`` / ``sampled``: cross-process trace adoption (the
+        ingestion RPC). ``sampled=None`` keeps today's local 1-in-N
+        decision; a bool ADOPTS the wire decision that rode in with the
+        producer's causality token, so every process records the same
+        writes. A locally-sampled submit with no token mints one, so
+        in-process callers get full chains too."""
         if source.kind not in ("source", "loop"):
             raise GraphError(
                 f"can only submit to sources/loops, not {source}")
@@ -282,7 +291,17 @@ class IngestFrontend:
                 batch_id = self._cursor(source).next_id()
             ticket = Ticket(batch_id)
             if _trace.ENABLED:
-                ticket.trace = _trace.mint(batch_id, t0)
+                if sampled is None:
+                    ticket.trace = _trace.mint(batch_id, t0)
+                    if cause is not None:
+                        ticket.trace.cause = cause
+                else:
+                    ticket.trace = _trace.TraceCtx(batch_id, t0,
+                                                   sampled, cause)
+                if ticket.trace.sampled and ticket.trace.cause is None:
+                    from reflow_tpu.obs.wire import node_id
+                    ticket.trace.cause = _trace.mint_cause(
+                        node_id(), getattr(self.sched, "epoch", 0))
             if batch_id in self._admitted:
                 self.deduped += 1
                 ticket._resolve(TicketResult(
@@ -770,6 +789,18 @@ class IngestFrontend:
                     for e in entries:
                         if e.device and e.preimage is not None:
                             push_pre(e.batch_id, e.preimage)
+        push_cause = getattr(self.sched, "push_cause", None)
+        if tr and wal is not None and push_cause is not None:
+            # register sampled tickets' causality tokens so the WAL
+            # stamps them onto this window's push records — the shipper
+            # and replicas then re-emit the same tokens, stitching the
+            # chain across processes
+            for f in feeds:
+                for entries in f.entries.values():
+                    for e in entries:
+                        ctx = e.ticket.trace
+                        if ctx is not None and ctx.cause:
+                            push_cause(e.batch_id, ctx.cause)
         k = self.window.max_ticks
         for i in range(0, len(feeds), k):
             chunk = feeds[i:i + k]
@@ -971,6 +1002,14 @@ class IngestFrontend:
                     ctx, t_adm=e.t_admitted, t_ready=block.t_ready,
                     t_exec0=block.t_exec0, t_exec1=block.t_exec1,
                     t_dur=t_dur, t_res=time.perf_counter())
+                if ctx.cause:
+                    # the write's durability boundary on the shared
+                    # chain: execute end -> durable watermark passed
+                    _trace.evt("wal_append", block.t_exec1,
+                               t_dur - block.t_exec1, track="wal",
+                               args={"batch_id": e.batch_id,
+                                     "cause": ctx.cause,
+                                     "lsn": block.lsn or None})
         with self._lock:
             self._pending_res -= 1
             self.ticks += block.nticks

@@ -51,6 +51,7 @@ from typing import Dict, List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
+from reflow_tpu.obs import flight as _flight
 from reflow_tpu.obs import trace as _trace
 from reflow_tpu.obs.registry import REGISTRY
 from reflow_tpu.scheduler import DirtyScheduler
@@ -58,7 +59,8 @@ from reflow_tpu.utils.runtime import named_lock
 from reflow_tpu.wal.log import (_MAGIC, LogPosition, WalError, _repair_tail,
                                 _seg_path, list_segments)
 from reflow_tpu.wal.recovery import replay_records
-from reflow_tpu.wal.ship import ShipAck, Shipment, ShipNack, iter_frames
+from reflow_tpu.wal.ship import (ShipAck, Shipment, ShipNack, iter_frames,
+                                 record_causes)
 
 __all__ = ["ReplicaScheduler", "CURSOR_FILE"]
 
@@ -195,6 +197,10 @@ class ReplicaScheduler:
                                args={"kind": "shipment", "epoch": ep,
                                      "fenced_by": self._epoch,
                                      "segment": sh.segment})
+                # a fence is exactly the moment this process may not
+                # outlive — get the evidence onto disk now
+                _flight.note("fence_reject", epoch=ep,
+                             fenced_by=self._epoch, segment=sh.segment)
                 return ShipNack(
                     tuple(self._cursor) if self._cursor else None,
                     f"fenced: shipment epoch {ep} < replica epoch "
@@ -237,12 +243,18 @@ class ReplicaScheduler:
             self._persist_cursor()
             ack = ShipAck(tuple(self._cursor), self._horizon)
         if _trace.ENABLED:
+            causes: List[str] = []
+            for _p, _e, r in entries:
+                for c in record_causes(r):
+                    if c not in causes:
+                        causes.append(c)
             _trace.evt("replica_replay", t0, time.perf_counter() - t0,
                        track=f"replica/{self.name}",
                        args={"segment": sh.segment, "bytes": len(sh.payload),
                              "records": len(entries), "applied": applied,
                              "horizon": ack.horizon,
                              "cause": getattr(sh, "cause", None),
+                             "causes": causes,
                              "lag_ticks": self.lag_ticks()})
         return ack
 
@@ -293,8 +305,18 @@ class ReplicaScheduler:
         if hub is not None and self._horizon > from_h:
             results = tuple(self.sched.history[hist0:])
             if len(results) == self._horizon - from_h:
+                causes: List[str] = []
+                if _trace.ENABLED:
+                    for _p, _e, r in window:
+                        for c in record_causes(r):
+                            if c not in causes:
+                                causes.append(c)
                 # O(1) hand-off: the hub's fan-out thread does the work
-                hub.on_window(from_h, self._horizon, results)
+                if causes:
+                    hub.on_window(from_h, self._horizon, results,
+                                  causes=tuple(causes))
+                else:
+                    hub.on_window(from_h, self._horizon, results)
             else:
                 # replay didn't tick one-for-one (restored state or a
                 # trimmed history) — deltas can't be trusted; re-snapshot
@@ -548,6 +570,9 @@ class ReplicaScheduler:
                              "replayed_pushes": report.replayed_pushes,
                              "replayed_ticks": report.replayed_ticks,
                              "final_tick": report.final_tick})
+        # promotion is a die-worthy moment for the flight ring: flush
+        # the failover evidence before this process does anything else
+        _flight.note("promote", epoch=new_epoch, horizon=horizon)
         if self._hub is not None:
             self._hub.rebase()   # subscribers re-snapshot off the leader
         return sched

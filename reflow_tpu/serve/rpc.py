@@ -64,12 +64,20 @@ _TERMINAL = (APPLIED, DEDUPED, REJECTED, SHED)
 
 
 class SubmitReq(NamedTuple):
-    """One producer submission as it crosses the wire."""
+    """One producer submission as it crosses the wire.
+
+    ``cause`` is the optional causality token minted at the producer
+    (``obs.trace.mint_cause``); its presence IS the sampling decision —
+    the server adopts it instead of re-rolling, so every process
+    records the same 1-in-N writes. Trailing + defaulted and trimmed
+    when None (:func:`_trim`) so untraced requests stay byte-identical
+    to the pre-trace wire protocol."""
 
     batch_id: str
     source: str                    # source/loop node name on the graph
     payload: Any                   # host DeltaBatch (picklable)
     timeout_s: Optional[float] = None
+    cause: Optional[str] = None
 
 
 class SubmitAck(NamedTuple):
@@ -86,6 +94,7 @@ class SubmitAck(NamedTuple):
     state: str
     result: Optional[tuple] = None
     reason: Optional[str] = None
+    cause: Optional[str] = None    # echo of the request token (traced)
 
 
 class TicketResolve(NamedTuple):
@@ -94,6 +103,22 @@ class TicketResolve(NamedTuple):
 
     batch_ids: tuple
     wait_s: float = 0.0
+
+
+def _trim(fields: tuple) -> tuple:
+    """Drop exactly one trailing None before a frame hits the wire —
+    the ``Shipment`` compat pattern (net/client.py): an unstamped
+    request/ack pickles byte-identically to the pre-``cause`` protocol,
+    while the receiving NamedTuple's default fills the gap."""
+    if fields and fields[-1] is None:
+        fields = fields[:-1]
+    return fields
+
+
+def _ticket_cause(ticket) -> Optional[str]:
+    """The causality token riding a server-side ticket's trace context
+    (None for unsampled/untraced tickets)."""
+    return getattr(getattr(ticket, "trace", None), "cause", None)
 
 
 def _result_fields(res: TicketResult) -> tuple:
@@ -221,7 +246,8 @@ class RpcIngestServer:
         if op == "hello":
             return self._op_hello(*args)
         if op == "submit":
-            return ("ack",) + tuple(self._op_submit(SubmitReq(*args)))
+            return ("ack",) + _trim(tuple(self._op_submit(
+                SubmitReq(*args))))
         if op == "resolve":
             return ("ok", self._op_resolve(TicketResolve(*args)))
         if op == "ping":
@@ -249,7 +275,11 @@ class RpcIngestServer:
 
     def _op_hello(self, producer, in_doubt_ids):
         """The dedup handshake: which of the producer's in-doubt ids
-        does the frontend's mirror already remember?"""
+        does the frontend's mirror already remember? The reply also
+        piggybacks this server's clock anchor (inside the dict — the
+        reply stays a 2-tuple for old clients) so producer-side spans
+        can be displayed on the leader's wall axis post-mortem."""
+        from reflow_tpu.obs.wire import clock_anchor
         fe = self.frontend
         sched = fe.sched
         return ("ok", {
@@ -257,6 +287,7 @@ class RpcIngestServer:
             "epoch": getattr(sched, "epoch", 0),
             "tick": sched._tick,
             "admitted": fe.admitted_ids(in_doubt_ids),
+            "anchor": clock_anchor(),
         })
 
     def _source_node(self, name: str):
@@ -268,38 +299,52 @@ class RpcIngestServer:
 
     def _op_submit(self, req: SubmitReq) -> SubmitAck:
         self.submits_total += 1
+        t0 = time.perf_counter()
         source = self._source_node(req.source)
         timeout = self._submit_cap
         if req.timeout_s is not None:
             timeout = min(timeout, req.timeout_s)
         try:
+            # the wire decision rides the token: a present ``cause``
+            # means the producer sampled this write, so the frontend
+            # adopts it (and its sampling bit) instead of re-rolling —
+            # every process then records the same writes
             ticket = self.frontend.submit(
                 source, req.payload, batch_id=req.batch_id,
-                timeout=timeout)
+                timeout=timeout, cause=req.cause,
+                sampled=(req.cause is not None))
         except FrontendClosed as e:
             # closed OR pump crashed: either way the producer holds the
             # payload and the mirror holds the truth — tell it to retry
             return SubmitAck(req.batch_id, "retry",
-                             reason=f"{type(e).__name__}: {e}")
+                             reason=f"{type(e).__name__}: {e}",
+                             cause=req.cause)
+        if _trace.ENABLED and req.cause is not None:
+            _trace.evt("rpc_admit", t0, time.perf_counter() - t0,
+                       track="rpc-server",
+                       args={"batch_id": req.batch_id,
+                             "cause": req.cause})
         return self._ack_of(ticket)
 
     def _ack_of(self, ticket) -> SubmitAck:
+        cause = _ticket_cause(ticket)
         if ticket.done():
             try:
                 res = ticket.result(timeout=0)
             except FrontendClosed as e:
                 return SubmitAck(ticket.batch_id, "retry",
-                                 reason=f"{type(e).__name__}: {e}")
+                                 reason=f"{type(e).__name__}: {e}",
+                                 cause=cause)
             with self._lock:
                 self._tickets.pop(ticket.batch_id, None)
             return SubmitAck(ticket.batch_id, res.status,
-                             result=_result_fields(res))
+                             result=_result_fields(res), cause=cause)
         with self._lock:
             self._tickets[ticket.batch_id] = ticket
             self._tickets.move_to_end(ticket.batch_id)
             while len(self._tickets) > self.max_tickets:
                 self._evict_one()
-        return SubmitAck(ticket.batch_id, "pending")
+        return SubmitAck(ticket.batch_id, "pending", cause=cause)
 
     def _evict_one(self) -> None:
         # caller holds the lock; prefer dropping a resolved ticket (its
@@ -322,14 +367,15 @@ class RpcIngestServer:
                            for b in req.batch_ids}
             for bid, t in tickets.items():
                 if t is None:
-                    out[bid] = tuple(SubmitAck(
+                    out[bid] = _trim(tuple(SubmitAck(
                         bid, "unknown",
-                        reason="no ticket on this server; resubmit"))
+                        reason="no ticket on this server; resubmit")))
                 elif t.done():
-                    out[bid] = tuple(self._ack_of(t))
+                    out[bid] = _trim(tuple(self._ack_of(t)))
                 else:
                     pending.append(t)
-                    out[bid] = tuple(SubmitAck(bid, "pending"))
+                    out[bid] = _trim(tuple(SubmitAck(
+                        bid, "pending", cause=_ticket_cause(t))))
             remaining = deadline - time.perf_counter()
             if not pending or remaining <= 0 or self._stop.is_set():
                 return out
@@ -365,16 +411,21 @@ class RemoteTicket:
     """
 
     __slots__ = ("batch_id", "source", "payload", "timeout_s",
-                 "submits", "link_gen", "_producer", "_result")
+                 "submits", "link_gen", "cause", "_producer", "_result")
 
     def __init__(self, producer: "RemoteProducer", batch_id: str,
-                 source: str, payload, timeout_s: Optional[float]):
+                 source: str, payload, timeout_s: Optional[float],
+                 cause: Optional[str] = None):
         self.batch_id = batch_id
         self.source = source
         self.payload = payload
         self.timeout_s = timeout_s
         self.submits = 0       # wire submits (resubmits = submits - 1)
         self.link_gen = -1     # dial generation the last submit rode
+        #: causality token for a sampled submission — minted ONCE, so
+        #: every resubmit of this batch rides the same token and the
+        #: post-failover chain still joins on string equality
+        self.cause = cause
         self._producer = producer
         self._result: Optional[TicketResult] = None
 
@@ -429,6 +480,10 @@ class RemoteProducer:
         self._pending: "OrderedDict[str, RemoteTicket]" = OrderedDict()
         #: server's answer to the last hello (graph/epoch/tick/admitted)
         self.last_hello: Optional[dict] = None
+        #: server clock anchor from the last hello (+ rtt_s /
+        #: wall_offset_s), when the server sends one; display-only —
+        #: never used for ordering
+        self.anchor: Optional[dict] = None
         self.submits_total = 0
         self.resubmits_total = 0
         self.reconnects_total = 0
@@ -462,7 +517,14 @@ class RemoteProducer:
             if batch_id is None:
                 batch_id = f"{self.name}-{self._seq}"
                 self._seq += 1
-            ticket = RemoteTicket(self, batch_id, src, batch, timeout)
+            cause = None
+            if _trace.ENABLED and _trace.sample():
+                # sampling is decided HERE, before any ticket exists on
+                # the server; the token carries the decision downstream
+                epoch = (self.last_hello or {}).get("epoch", 0)
+                cause = _trace.mint_cause(self.name, epoch)
+            ticket = RemoteTicket(self, batch_id, src, batch, timeout,
+                                  cause=cause)
             self._pending[batch_id] = ticket
             self._ensure_link()
             self._push(ticket)
@@ -544,6 +606,17 @@ class RemoteProducer:
         self._conn = conn
         self._gen += 1
         self.last_hello = dict(resp[1])
+        anchor = self.last_hello.get("anchor")
+        if isinstance(anchor, dict):
+            # pre-anchor servers omit the key; newer ones piggyback a
+            # clock anchor so this producer's spans can be shown on the
+            # leader's wall axis (error bounded by rtt/2)
+            rtt = time.perf_counter() - t0
+            anchor = dict(anchor)
+            anchor["rtt_s"] = rtt
+            anchor["wall_offset_s"] = anchor.get("wall", 0.0) - \
+                (time.time() - rtt / 2.0)
+            self.anchor = anchor
         if _trace.ENABLED:
             _trace.evt("net_reconnect", t0, time.perf_counter() - t0,
                        track=f"rpc/{self.name}",
@@ -551,7 +624,8 @@ class RemoteProducer:
                              "in_doubt": len(self._pending)})
         return True
 
-    def _roundtrip(self, msg: tuple) -> Any:
+    def _roundtrip(self, msg: tuple,
+                   cause: Optional[str] = None) -> Any:
         conn = self._conn
         if conn is None:
             return None
@@ -562,16 +636,20 @@ class RemoteProducer:
         except TransportError as e:
             self._fail(e)
             if _trace.ENABLED:
+                args = {"op": msg[0], "ok": False,
+                        "error": str(e)[:120]}
+                if cause is not None:
+                    args["cause"] = cause
                 _trace.evt("net_send", t0, time.perf_counter() - t0,
-                           track=f"rpc/{self.name}",
-                           args={"op": msg[0], "ok": False,
-                                 "error": str(e)[:120]})
+                           track=f"rpc/{self.name}", args=args)
             return None
         self.policy.ok()
         if _trace.ENABLED:
+            args = {"op": msg[0], "ok": True}
+            if cause is not None:
+                args["cause"] = cause
             _trace.evt("net_send", t0, time.perf_counter() - t0,
-                       track=f"rpc/{self.name}", args={"op": msg[0],
-                                                       "ok": True})
+                       track=f"rpc/{self.name}", args=args)
         return resp
 
     def _push(self, ticket: RemoteTicket) -> None:
@@ -584,9 +662,22 @@ class RemoteProducer:
         ticket.submits += 1
         ticket.link_gen = self._gen
         req = SubmitReq(ticket.batch_id, ticket.source, ticket.payload,
-                        ticket.timeout_s)
+                        ticket.timeout_s, ticket.cause)
         self.submits_total += 1
-        resp = self._roundtrip(("submit",) + tuple(req))
+        t0 = time.perf_counter()
+        resp = self._roundtrip(("submit",) + _trim(tuple(req)),
+                               cause=ticket.cause)
+        if _trace.ENABLED and ticket.cause is not None:
+            # the producer's end of the chain: submit sent -> ack (or
+            # link loss) — freshness decomposition anchors ack->deliver
+            # at this span's start
+            _trace.evt("producer_submit", t0,
+                       time.perf_counter() - t0,
+                       track=f"rpc/{self.name}",
+                       args={"batch_id": ticket.batch_id,
+                             "cause": ticket.cause,
+                             "submits": ticket.submits,
+                             "ok": resp is not None})
         if isinstance(resp, tuple) and resp and resp[0] == "ack":
             self._apply_ack(ticket, SubmitAck(*resp[1:]))
         elif isinstance(resp, tuple) and resp and resp[0] == "err":

@@ -72,6 +72,9 @@ class Subscriber:
         #: server's answer to the last handshake
         self.last_ack: Optional[SubAck] = None
         self.mode: Optional[str] = None
+        #: server clock anchor from the last handshake (rtt_s /
+        #: wall_offset_s added client-side) — post-mortem alignment
+        self.anchor: Optional[dict] = None
         self.polls_total = 0
         self.heartbeats_total = 0
         self.handshakes_total = 0
@@ -199,7 +202,7 @@ class Subscriber:
                            args={"ok": False, "error": str(e)[:120],
                                  "state": self.policy.state})
             return False
-        if not (isinstance(resp, tuple) and len(resp) == 4
+        if not (isinstance(resp, tuple) and len(resp) >= 4
                 and resp[0] == "ok"):
             conn.close()
             self._fail(TransportError(f"bad sub response {resp!r}"))
@@ -208,9 +211,7 @@ class Subscriber:
         if recovered:
             self.reconnects_total += 1
         self._conn = conn
-        self.last_ack = SubAck(*resp[1:])
-        self.mode = self.last_ack.mode
-        self.handshakes_total += 1
+        self._accept_ack(resp, rtt=time.perf_counter() - t0)
         if _trace.ENABLED:
             _trace.evt("net_reconnect", t0, time.perf_counter() - t0,
                        track=f"subs/{self.name}",
@@ -233,16 +234,30 @@ class Subscriber:
     def _rehandshake(self) -> bool:
         """Re-run the subscribe op on the live connection (after a
         ``gone`` or a detected gap). Caller holds the lock."""
+        t0 = time.perf_counter()
         resp = self._roundtrip(("sub",) + tuple(self._sub_req()))
-        if not (isinstance(resp, tuple) and len(resp) == 4
+        if not (isinstance(resp, tuple) and len(resp) >= 4
                 and resp[0] == "ok"):
             if self._conn is not None:
                 self._fail(TransportError(f"bad sub response {resp!r}"))
             return False
-        self.last_ack = SubAck(*resp[1:])
+        self._accept_ack(resp, rtt=time.perf_counter() - t0)
+        return True
+
+    def _accept_ack(self, resp: tuple, rtt: Optional[float] = None) -> None:
+        """Record a successful handshake reply; parses the trailing
+        clock anchor when the server sends one (older servers reply
+        without it — both directions stay compatible)."""
+        self.last_ack = SubAck(*resp[1:4])
         self.mode = self.last_ack.mode
         self.handshakes_total += 1
-        return True
+        if len(resp) >= 5 and isinstance(resp[4], dict):
+            anchor = dict(resp[4])
+            if rtt is not None:
+                anchor["rtt_s"] = rtt
+                anchor["wall_offset_s"] = anchor.get("wall", 0.0) - (
+                    time.time() - rtt / 2.0)
+            self.anchor = anchor
 
     def _poll_once(self, wait_s: float) -> Optional[int]:
         """One poll round-trip. Caller holds the lock. None on link
@@ -268,8 +283,19 @@ class Subscriber:
         gaps_before = self.state.gaps
         applied = 0
         for frame in frames:
-            if self.state.apply(frame):
+            t_apply = time.perf_counter()
+            ok = self.state.apply(frame)
+            if ok:
                 applied += 1
+            if _trace.ENABLED and ok and getattr(frame, "cause", None):
+                # the last link of the write's chain: a sampled write
+                # is now visible in this subscriber's local answer.
+                _trace.evt("sub_deliver", t_apply,
+                           time.perf_counter() - t_apply,
+                           track=f"subs/{self.name}",
+                           args={"from_h": frame.from_h,
+                                 "to_h": frame.to_h,
+                                 "causes": list(frame.cause)})
         self.state.note_horizon(horizon)
         if not frames:
             self.heartbeats_total += 1

@@ -209,6 +209,15 @@ class SubscriptionHub:
         self.rebases_total = 0
         self.reaped_total = 0
         self.wq_overflows = 0
+        # causality tokens drained from windows but not yet stamped on
+        # an emitted frame (a sampled write whose window produced no
+        # frame for any fan yet rides the next frame that does emit —
+        # chains must not tear on quiet queries). Fan-out thread only.
+        self._pending_causes: List[str] = []
+        # reservoir of window-recv → frame-emit latencies (seconds):
+        # the in-hub slice of ack→push freshness, exported as
+        # subs.freshness_p50/p99.
+        self._freshness: deque = deque(maxlen=512)
         self.pump_errors = 0
         self.pump_error: Optional[BaseException] = None
         self._metric_names: List[Tuple[object, str]] = []
@@ -219,16 +228,21 @@ class SubscriptionHub:
 
     # -- replica-facing ----------------------------------------------------
 
-    def on_window(self, from_h: int, to_h: int, results: tuple) -> None:
+    def on_window(self, from_h: int, to_h: int, results: tuple,
+                  causes: Optional[tuple] = None) -> None:
         """Called by the replica after applying a commit window
         ``(from_h, to_h]``; ``results`` holds one ``TickResult`` per
-        tick. O(1), bounded, never blocks the apply path."""
+        tick. ``causes`` carries the causality tokens of any sampled
+        writes in the window (tracing on) — they ride the emitted
+        :class:`DeltaFrame`\\ s so the chain reaches subscribers. O(1),
+        bounded, never blocks the apply path."""
         with self._wq_lock:
             if len(self._wq) >= _WQ_MAX:
                 self._wq.clear()
                 self._rebase_all = True
                 self.wq_overflows += 1
-            self._wq.append((from_h, to_h, results))
+            self._wq.append((from_h, to_h, results, causes,
+                             time.perf_counter()))
             self.windows_total += 1
             self._wq_cond.notify_all()
 
@@ -414,8 +428,13 @@ class SubscriptionHub:
         if rebase_all:
             self._mirrors.clear()
             windows = []
+            self._pending_causes.clear()
             self._flag_all_rebase()
             self.rebases_total += 1
+        for w in windows:
+            for c in (w[3] or ()):
+                if c not in self._pending_causes:
+                    self._pending_causes.append(c)
         for s in sinks:
             if s not in self._mirrors:
                 h, view = self.replica.view_at(s)
@@ -423,6 +442,7 @@ class SubscriptionHub:
         round_deltas = self._advance_mirrors(windows)
         appended = 0
         rows_out = 0
+        emitted_causes: Optional[tuple] = None
         if shed_level >= 2:
             # paused: mirrors advanced (correctness kept), nothing
             # emitted; every live subscriber owes a snapshot on resume.
@@ -433,6 +453,8 @@ class SubscriptionHub:
                     fan.last_topk = None
             self._flag_all_rebase()
         else:
+            causes = tuple(self._pending_causes) or None
+            delta_frames = 0
             for q, fan, tokens in fans:
                 mirror = self._mirrors.get(q.sink)
                 if mirror is None:
@@ -447,13 +469,21 @@ class SubscriptionHub:
                 if rows is None:
                     continue
                 frame = DeltaFrame(fan.last_emit_h, mirror.h, q.kind,
-                                   rows, False)
+                                   rows, False, causes)
                 if q.kind == "topk":
                     fan.last_topk = rows
                 fan.last_emit_h = mirror.h
+                delta_frames += 1
                 n = self._fan_out(frame, tokens)
                 appended += n
                 rows_out += n * len(rows)
+            if delta_frames:
+                emitted_causes = causes
+                self._pending_causes.clear()
+                if windows:
+                    emit_t = time.perf_counter()
+                    for w in windows:
+                        self._freshness.append(emit_t - w[4])
             appended += self._service_rebases()
         reaped = self._reap_expired()
         # order matters: frames land in outboxes (above) before the
@@ -468,6 +498,12 @@ class SubscriptionHub:
                 shard.cond.notify_all()
         self.frames_total += appended
         self.fanout_rows_total += rows_out
+        if _trace.ENABLED and emitted_causes:
+            _trace.evt("sub_fanout", t0, time.perf_counter() - t0,
+                       track=f"subs/{self.name}",
+                       args={"frames": appended,
+                             "causes": list(emitted_causes),
+                             "horizon": self._fanout_h})
         if _trace.ENABLED and (appended or windows or reaped):
             _trace.evt("sub_push", t0, time.perf_counter() - t0,
                        track=f"subs/{self.name}",
@@ -481,7 +517,7 @@ class SubscriptionHub:
         per-sink delta accumulated over exactly the span each mirror
         advanced this round."""
         round_deltas: Dict[str, Dict] = {}
-        for from_h, to_h, results in windows:
+        for from_h, to_h, results, _causes, _recv in windows:
             for s, mirror in self._mirrors.items():
                 if mirror.h >= to_h:
                     continue
@@ -709,4 +745,17 @@ class SubscriptionHub:
         reg.gauge(f"{base}.slowest_lag",
                   lambda: self.slowest_lag() or 0)
         reg.gauge(f"{base}.shed_level", lambda: self._shed_level)
+        reg.gauge(f"{base}.freshness_p50",
+                  lambda: self.freshness_pct(0.50))
+        reg.gauge(f"{base}.freshness_p99",
+                  lambda: self.freshness_pct(0.99))
         self._metric_names.append((reg, base))
+
+    def freshness_pct(self, q: float) -> float:
+        """Percentile (seconds) of window-recv → frame-emit latency
+        over the recent reservoir; 0.0 until the first emission."""
+        snap = sorted(self._freshness)
+        if not snap:
+            return 0.0
+        i = min(len(snap) - 1, int(q * (len(snap) - 1) + 0.5))
+        return snap[i]

@@ -55,12 +55,20 @@ class DeltaFrame(NamedTuple):
 
     ``rows`` for view/lookup: ``((key_value_pair, dweight), ...)``
     (absolute weights when ``snapshot``); for topk: the full ranked
-    ``((key_value_pair, weight), ...)`` — always absolute."""
+    ``((key_value_pair, weight), ...)`` — always absolute.
+
+    ``cause`` is the optional tuple of causality tokens
+    (``obs.trace.mint_cause``) of the sampled writes folded into this
+    frame's window — the ``Shipment`` pattern extended to the push
+    path: trailing + defaulted, trimmed off the wire form when None
+    (:func:`frames_to_wire`) so tracing-off frames stay byte-identical
+    to the pre-trace protocol."""
     from_h: int
     to_h: int
     kind: str
     rows: tuple
     snapshot: bool
+    cause: Optional[tuple] = None
 
 
 def canon_query(sink: str, kind: str, params: Sequence = ()) -> StandingQuery:
@@ -253,9 +261,10 @@ def merge_frames(frames: Sequence[DeltaFrame]) -> DeltaFrame:
         return frames[0]
     kind = frames[0].kind
     first, last = frames[0], frames[-1]
+    cause = _merge_causes(frames)
     if kind == "topk":
         return DeltaFrame(first.from_h, last.to_h, kind, last.rows,
-                          any(f.snapshot for f in frames))
+                          any(f.snapshot for f in frames), cause)
     start = 0
     snapshot = False
     for i in range(len(frames) - 1, -1, -1):
@@ -267,12 +276,34 @@ def merge_frames(frames: Sequence[DeltaFrame]) -> DeltaFrame:
         for kv, w in f.rows:
             acc[kv] = acc.get(kv, 0) + w
     rows = tuple((kv, w) for kv, w in acc.items() if w != 0)
-    return DeltaFrame(first.from_h, last.to_h, kind, rows, snapshot)
+    return DeltaFrame(first.from_h, last.to_h, kind, rows, snapshot,
+                      cause)
+
+
+def _merge_causes(frames: Sequence[DeltaFrame]) -> Optional[tuple]:
+    """Union (ordered, deduplicated) of the merged frames' causality
+    tokens — conflation must not orphan a sampled write's chain."""
+    out: List = []
+    for f in frames:
+        for c in getattr(f, "cause", None) or ():
+            if c not in out:
+                out.append(c)
+    return tuple(out) if out else None
 
 
 def frames_to_wire(frames: Sequence[DeltaFrame]) -> Tuple[tuple, ...]:
-    """Plain-tuple form for pickling over ``net/`` framing."""
-    return tuple(tuple(f) for f in frames)
+    """Plain-tuple form for pickling over ``net/`` framing. Each
+    frame's one trailing None (an unstamped ``cause``) is trimmed — the
+    ``Shipment`` compat pattern — so tracing-off frames pickle
+    byte-identically to the pre-``cause`` protocol, and
+    :func:`frames_from_wire` refills the default."""
+    out = []
+    for f in frames:
+        fields = tuple(f)
+        if fields and fields[-1] is None:
+            fields = fields[:-1]
+        out.append(fields)
+    return tuple(out)
 
 
 def frames_from_wire(raw: Sequence[tuple]) -> List[DeltaFrame]:

@@ -3,7 +3,8 @@
 Pickled tuples over the shared length-prefixed CRC framing (the same
 carrier as replication and the ingestion RPC)::
 
-    ("sub",) + SubscribeReq       -> ("ok",) + SubAck | ("err", text)
+    ("sub",) + SubscribeReq       -> ("ok",) + SubAck + (anchor,)
+                                     | ("err", text)
     ("sub_poll", token, acked,
                  wait_s)          -> ("ok", frames, horizon)
                                      | ("gone", token) | ("err", text)
@@ -182,8 +183,13 @@ class SubscriptionServer:
         token, mode = self.hub.subscribe(
             req.sink, req.kind, req.params, token=req.token,
             cursor=req.cursor, min_horizon=req.min_horizon, wire=True)
+        # trailing clock anchor (obs.wire.clock_anchor) piggybacks on
+        # the handshake so post-mortem tools can align this process's
+        # monotonic clock; older clients ignore extra elements.
+        from reflow_tpu.obs.wire import clock_anchor
         return ("ok",) + tuple(
-            SubAck(token, self.hub.fanout_horizon, mode))
+            SubAck(token, self.hub.fanout_horizon, mode)) + (
+            clock_anchor(),)
 
     def _op_poll(self, token, acked, wait_s):
         self.polls_total += 1

@@ -396,6 +396,37 @@ declare("REFLOW_BENCH_SUBS_RUN_S", "float", None,
         "subs bench per-leg write window seconds "
         "(default 2.0, smoke 0.6)")
 
+# -- end-to-end tracing & flight recorder ('Follow-the-write') ---------------
+
+declare("REFLOW_FLIGHT", "flag", False,
+        "per-process flight recorder: tee sampled spans and "
+        "control-plane events into a bounded on-disk ring in the "
+        "node's disk corner, kill -9 recoverable "
+        "(tools/reflow_flight.py merges the corners post-mortem)")
+declare("REFLOW_FLIGHT_DIR", "str", None,
+        "flight recorder directory override (default: <node "
+        "root>/flight when run under proc/, else ./flight)")
+declare("REFLOW_FLIGHT_BYTES", "int", 1 << 20,
+        "flight recorder on-disk budget in bytes, split across two "
+        "alternating generation files — the ring rotates, it never "
+        "grows")
+declare("REFLOW_FLIGHT_FLUSH_EVERY", "int", 64,
+        "flight recorder flushes after this many buffered events "
+        "(control-plane events — fence/promote/breaker — always "
+        "flush eagerly)")
+declare("REFLOW_BENCH_E2ETRACE", "flag", False,
+        "bench mode: follow-the-write — multiproc topology under "
+        "16-producer load with live wire subscribers and tracing on; "
+        "kill -9 a replica and the leader mid-run, then assert "
+        "sampled writes show complete submit→deliver chains, the "
+        "freshness decomposition tiles ack→deliver, and every killed "
+        "child's flight recording is recovered from its disk corner")
+declare("REFLOW_BENCH_E2ETRACE_RUN_S", "float", None,
+        "e2etrace bench per-leg write window seconds "
+        "(default 1.5, smoke 0.6)")
+declare("REFLOW_BENCH_E2ETRACE_PRODUCERS", "int", 16,
+        "e2etrace bench producer process count")
+
 
 # -- the config dataclass ---------------------------------------------------
 

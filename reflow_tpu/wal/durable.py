@@ -88,6 +88,12 @@ class DurableScheduler(DirtyScheduler):
         #: batch_id -> host pre-image of an uploaded device batch,
         #: consumed (popped) when that batch is logged
         self._preimages: Dict[str, DeltaBatch] = {}
+        #: batch_id -> causality token (obs.trace.mint_cause) to stamp
+        #: onto that batch's WAL push record, consumed when logged —
+        #: replicas and the shipper re-emit the token so the trace
+        #: chain stitches across processes (tracing-on only; replay
+        #: ignores unknown record keys)
+        self._causes: Dict[str, str] = {}
         #: forced host readbacks on the logging path (device batch, no
         #: pre-image) — the streaming zero-readback property's counter
         self.log_readbacks = 0
@@ -97,6 +103,15 @@ class DurableScheduler(DirtyScheduler):
     def _crash_point(self, name: str) -> None:
         if self._crash is not None:
             self._crash.point(name)
+
+    @property
+    def epoch(self) -> int:
+        """Leader epoch stamped into every appended record — the WAL
+        owns it (promotion mints the new one there). Surfaced so the
+        ingestion RPC's hello can advertise the true epoch: producer
+        causality tokens minted after a failover must carry the new
+        epoch, not 0."""
+        return self.wal.epoch
 
     # -- ingestion ---------------------------------------------------------
 
@@ -123,6 +138,24 @@ class DurableScheduler(DirtyScheduler):
                 f"pass the host DeltaBatch that was uploaded")
         self._preimages[batch_id] = batch
 
+    def push_cause(self, batch_id: str, cause: str) -> None:
+        """Register the causality token riding ``batch_id`` (the serve
+        frontend does this for sampled tickets): the batch's WAL push
+        record is stamped with it, so the shipper and every replica
+        replaying the record can re-emit the same token. Consumed by
+        the next log of that id; dropped on dedup or seal."""
+        self._causes[batch_id] = cause
+
+    def _record_causes(self, ids) -> list:
+        """Pop the registered tokens of a record's batch ids (one per
+        sampled micro-batch; coalesced records may carry several)."""
+        out = []
+        for bid in ids:
+            c = self._causes.pop(bid, None)
+            if c is not None:
+                out.append(c)
+        return out
+
     def _host_image(self, batch, batch_id: str):
         """(host_bytes_for_log, batch_to_execute): a device batch with a
         registered pre-image logs the pre-image and executes untouched;
@@ -142,7 +175,7 @@ class DurableScheduler(DirtyScheduler):
                   batch_id: str) -> DeltaBatch:
         image, batch = self._host_image(batch, batch_id)
         self._crash_point("before_append")
-        self.wal.append({
+        rec = {
             "kind": "push",
             "tick": self._tick,
             "node": source.id,
@@ -151,7 +184,11 @@ class DurableScheduler(DirtyScheduler):
             "keys": image.keys,
             "values": image.values,
             "weights": image.weights,
-        })
+        }
+        causes = self._record_causes((batch_id,))
+        if causes:
+            rec["cause"] = causes[0]
+        self.wal.append(rec)
         self._crash_point("after_append")
         return batch
 
@@ -166,6 +203,7 @@ class DurableScheduler(DirtyScheduler):
             batch_id = self._mint_auto_id(source)
         elif batch_id in self._seen_batch_ids:
             self._preimages.pop(batch_id, None)
+            self._causes.pop(batch_id, None)
             return False  # duplicate: nothing to make durable
         batch = self._log_push(source, batch, batch_id)
         accepted = super().push(source, batch, batch_id=batch_id)
@@ -260,6 +298,11 @@ class DurableScheduler(DirtyScheduler):
                     # several micro-batches coalesced into this one feed
                     # batch: their ids commit (and replay) atomically
                     rec["batch_ids"] = ids
+                causes = self._record_causes(ids)
+                if causes:
+                    rec["cause"] = causes[0]
+                    if len(causes) > 1:
+                        rec["causes"] = tuple(causes)
                 records.append(rec)
             logged.append(entry)
         return logged, records
@@ -302,4 +345,5 @@ class DurableScheduler(DirtyScheduler):
         the serving frontend's ``close()`` and a caller's own shutdown
         path may both reach it."""
         self._preimages.clear()
+        self._causes.clear()
         self.wal.close()

@@ -59,7 +59,8 @@ from reflow_tpu.wal.log import (_HEADER, _MAGIC, LogPosition, WalError,
                                 list_segments)
 
 __all__ = ["Shipment", "ShipAck", "ShipNack", "SegmentShipper",
-           "iter_frames", "SHIP_STATE_FILE", "SHIP_STATE_SCHEMA"]
+           "iter_frames", "record_causes", "SHIP_STATE_FILE",
+           "SHIP_STATE_SCHEMA"]
 
 SHIP_STATE_FILE = "ship-state.json"
 SHIP_STATE_SCHEMA = "reflow.wal_ship/1"
@@ -148,6 +149,23 @@ def iter_frames(payload: bytes, segment: int, base: int,
                         LogPosition(segment, base + end), rec))
         off = end
     return entries, off, None
+
+
+def record_causes(rec) -> List[str]:
+    """Causality tokens stamped on one WAL push record
+    (``DurableScheduler.push_cause``): the singular ``cause`` plus any
+    coalesced ``causes`` overflow, deduplicated in order. Empty for
+    unstamped (tracing-off) records."""
+    if not isinstance(rec, dict):
+        return []
+    out: List[str] = []
+    c = rec.get("cause")
+    if c:
+        out.append(c)
+    for x in rec.get("causes") or ():
+        if x not in out:
+            out.append(x)
+    return out
 
 
 class _FollowerState:
@@ -376,6 +394,7 @@ class SegmentShipper:
             # chunk forever (cursor livelock)
             payload = b""
             chunk_end = cur.offset
+            entries = []
         else:
             with open(segs[cur.segment], "rb") as f:
                 f.seek(cur.offset)
@@ -411,12 +430,20 @@ class SegmentShipper:
         seals = sealed and chunk_end == end
         nxt = self._next_segment(segs, cur.segment) if seals else None
         tok: Optional[str] = None
+        causes: List[str] = []
         if _trace.ENABLED:
             # stamp a causality token so this chunk's ship_segment /
             # net_send / replica_replay spans stitch across processes;
             # lazy import — obs.wire rides net/, which rides this module
             from reflow_tpu.obs.wire import node_id as _node_id
             tok = _trace.mint_cause(_node_id(), self.epoch)
+            # per-write tokens stamped on the chunk's WAL records: the
+            # span carries BOTH, joining each sampled write's chain to
+            # the chunk-level ship/send/replay spans
+            for _p, _e, r in entries:
+                for c in record_causes(r):
+                    if c not in causes:
+                        causes.append(c)
         shipment = Shipment(cur.segment, cur.offset, payload, chunk_end,
                             seals, nxt, self._leader_tick(), self.epoch,
                             tok)
@@ -439,6 +466,7 @@ class SegmentShipper:
                              "bytes": len(payload),
                              "seals": seals,
                              "cause": tok,
+                             "causes": causes,
                              "ack": isinstance(resp, ShipAck)})
         if resp is None:
             # link-level no-progress (remote follower down or inside a

@@ -418,7 +418,8 @@ def test_trace_inspect_cli(tmp_path, traced, capsys):
     ti = _load_tool("trace_inspect")
     assert ti.main([path, "--json"]) == 0
     out = json.loads(capsys.readouterr().out)
-    assert out["schema"] == "reflow.trace_inspect/1"
+    assert out["schema"] == "reflow.trace_inspect/2"
+    assert out["trace_files"] == [path]
     assert out["tickets"] > 0
     assert out["decomposition_max_dev_frac"] < 0.10
     assert set(out["critical_path"]) == set(trace_mod.STAGES)

@@ -122,6 +122,14 @@ def _print_fleet(snap: dict) -> None:
               f"{'n/a' if rows is None else f'{rows:.1f}'} row/s   "
               f"slowest lag: "
               f"{'n/a' if lag is None else int(lag)} window(s)")
+    f50 = g.get("subs.freshness_p50")
+    f99 = g.get("subs.freshness_p99")
+    fev = g.get("flight.events_total")
+    if f50 is not None or f99 is not None or fev is not None:
+        print(f"  freshness: p50 "
+              f"{'n/a' if f50 is None else f'{f50 * 1e3:.1f}ms'}   p99 "
+              f"{'n/a' if f99 is None else f'{f99 * 1e3:.1f}ms'}   "
+              f"flight events: {'n/a' if fev is None else int(fev)}")
     hdr = (f"  {'node':<16} {'horizon':>8} {'lag':>5} {'qps':>8} "
            f"{'epoch':>6} {'age_s':>7}  state")
     print(hdr)

@@ -1,0 +1,151 @@
+#!/usr/bin/env python3
+"""Merge the fleet's flight recorders into one post-mortem timeline.
+
+Usage::
+
+    python tools/reflow_flight.py ROOT            # scan ROOT/**/flight/
+    python tools/reflow_flight.py DIR1 DIR2 ...   # explicit corners
+    ... --json                                    # machine form
+    ... --last 50                                 # tail of the timeline
+
+Each process's :class:`~reflow_tpu.obs.flight.FlightRecorder` writes a
+bounded JSONL ring under its own state directory (``<root>/<node>/
+flight/``); every file header carries a ``{mono, wall}`` clock anchor
+taken when the file was opened. The merger maps each event's
+process-local monotonic timestamp onto the wall clock through its
+file's anchor (``wall = anchor.wall + (mono - anchor.mono)``) and
+sorts the union — one fleet-wide timeline that still works when some
+of the processes were kill -9'd mid-write (torn final lines are
+dropped by the reader; a respawned node's dead incarnation survives as
+the ``.prev`` generation).
+
+Wall-clock caveat: all the chaos topologies run on one host, where
+``CLOCK_MONOTONIC`` is shared and the anchors differ only by file-open
+time — orderings across processes are honest. Across *hosts* the
+anchors inherit NTP skew; the timeline is for operator forensics, not
+for ordering proofs (those ride the causality tokens in the spans
+themselves).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from reflow_tpu.obs.flight import read_flight_dir  # noqa: E402
+
+MERGED_SCHEMA = "reflow.flight_merged/1"
+
+
+def find_corners(paths) -> list:
+    """Flight directories under the given roots: a path that *is* a
+    corner (contains flight-*.jsonl) is taken as-is; otherwise its
+    tree is scanned for ``flight/`` directories."""
+    corners = []
+    for p in paths:
+        if not os.path.isdir(p):
+            continue
+        if any(fn.startswith("flight-") and fn.endswith((".jsonl",
+                                                         ".jsonl.prev"))
+               for fn in os.listdir(p)):
+            corners.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            if os.path.basename(dirpath) == "flight" and any(
+                    fn.startswith("flight-") for fn in filenames):
+                corners.append(dirpath)
+                dirnames[:] = []
+    return sorted(set(corners))
+
+
+def merge(paths) -> dict:
+    """Read every corner under ``paths`` and merge into the
+    ``reflow.flight_merged/1`` report: clock-anchored events sorted on
+    the reconstructed wall axis, plus per-node file accounting."""
+    corners = find_corners(paths)
+    events = []
+    nodes: dict = {}
+    for corner in corners:
+        for parsed in read_flight_dir(corner):
+            hdr = parsed["header"]
+            node = hdr.get("node", "?")
+            anchor = hdr.get("anchor", {})
+            a_mono = float(anchor.get("mono", 0.0))
+            a_wall = float(anchor.get("wall", 0.0))
+            entry = nodes.setdefault(node, {
+                "files": 0, "events": 0, "pids": [], "corner": corner})
+            entry["files"] += 1
+            entry["events"] += len(parsed["events"])
+            pid = hdr.get("pid")
+            if pid is not None and pid not in entry["pids"]:
+                entry["pids"].append(pid)
+            for ev in parsed["events"]:
+                mono = float(ev.get("mono", 0.0))
+                events.append({
+                    "t_wall": a_wall + (mono - a_mono),
+                    "node": node,
+                    "pid": pid,
+                    "kind": ev.get("kind", "span"),
+                    "name": ev.get("name", "?"),
+                    "dur": ev.get("dur", 0.0),
+                    "track": ev.get("track"),
+                    "args": ev.get("args"),
+                })
+    events.sort(key=lambda e: (e["t_wall"], e["node"], e["name"]))
+    return {"schema": MERGED_SCHEMA, "corners": corners,
+            "nodes": nodes, "events": events}
+
+
+def _print_human(report: dict, last: int) -> None:
+    nodes = report["nodes"]
+    print(f"{len(nodes)} node(s), "
+          f"{sum(n['events'] for n in nodes.values())} event(s) across "
+          f"{sum(n['files'] for n in nodes.values())} flight file(s)")
+    for name, n in sorted(nodes.items()):
+        print(f"  {name:<16} {n['events']:>6} event(s) in "
+              f"{n['files']} file(s)  pids={n['pids']}  {n['corner']}")
+    events = report["events"]
+    if not events:
+        return
+    base = events[0]["t_wall"]
+    shown = events[-last:] if last else events
+    if len(shown) < len(events):
+        print(f"  ... ({len(events) - len(shown)} earlier event(s))")
+    for ev in shown:
+        args = ev.get("args") or {}
+        cause = args.get("cause") or ""
+        extra = f" cause={cause}" if cause else ""
+        if "causes" in args:
+            extra += f" causes={len(args['causes'])}"
+        print(f"  +{ev['t_wall'] - base:10.4f}s {ev['node']:<12} "
+              f"{ev['kind']:<5} {ev['name']:<18} "
+              f"{1e3 * float(ev.get('dur') or 0.0):8.3f}ms{extra}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="fleet root(s) or explicit flight corner(s)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the merged timeline as one JSON line")
+    ap.add_argument("--last", type=int, default=0, metavar="N",
+                    help="human mode: show only the last N events")
+    args = ap.parse_args(argv)
+    report = merge(args.paths)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        _print_human(report, args.last)
+    if not report["nodes"]:
+        print("reflow_flight: no flight recordings found under "
+              f"{args.paths}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

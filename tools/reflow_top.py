@@ -53,11 +53,19 @@ def render(snap: dict, *, stale_link: bool = False) -> str:
     if g.get("subs_active") is not None:
         srows = g.get("sub_rows_s")
         slag = g.get("sub_lag_windows")
+        f50 = g.get("subs.freshness_p50")
+        f99 = g.get("subs.freshness_p99")
+        fev = g.get("flight.events_total")
         lines.append(
             f"subs {int(g['subs_active'])} active | fan-out "
             f"{('n/a' if srows is None else f'{srows:.1f}')} row/s | "
             f"slowest lag "
-            f"{('n/a' if slag is None else int(slag))} window(s)")
+            f"{('n/a' if slag is None else int(slag))} window(s) | "
+            f"fresh p50 "
+            f"{('n/a' if f50 is None else f'{f50 * 1e3:.1f}ms')} p99 "
+            f"{('n/a' if f99 is None else f'{f99 * 1e3:.1f}ms')} | "
+            f"flight "
+            f"{('n/a' if fev is None else int(fev))}")
     lines.append(f"{'NODE':<16} {'HORIZON':>8} {'LAG':>5} {'QPS':>8} "
                  f"{'EPOCH':>6} {'AGE':>7} LINKS")
     for name, e in sorted(nodes.items()):
@@ -85,13 +93,19 @@ def render(snap: dict, *, stale_link: bool = False) -> str:
             srows = e.get("sub_rows_s")
             slag = e.get("sub_lag_windows")
             sconf = e.get("sub_conflations")
+            nf50 = e.get("sub_freshness_p50")
             lines.append(
                 f"{'':<16} subs: {int(e['subs_active'])} active, "
                 f"{('n/a' if srows is None else f'{srows:.1f}')} row/s, "
                 f"conflated "
                 f"{('n/a' if sconf is None else int(sconf))}, "
                 f"lag "
-                f"{('n/a' if slag is None else int(slag))} window(s)")
+                f"{('n/a' if slag is None else int(slag))} window(s), "
+                f"fresh p50 "
+                f"{('n/a' if nf50 is None else f'{nf50 * 1e3:.1f}ms')}")
+        fev = e.get("flight_events")
+        if fev is not None:
+            lines.append(f"{'':<16} flight: {int(fev)} event(s) recorded")
     for line in snap.get("alerts", []):
         lines.append(f"ALERT: {line}")
     return "\n".join(lines)

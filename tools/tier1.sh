@@ -468,4 +468,43 @@ print(f"TIER1 subs smoke: {r['subscribers']} subscribers, "
       f"gap-free")
 EOF
 fi
+
+# optional (RUN_BENCH=1): the e2etrace smoke — follow-the-write across
+# the whole process fleet: sampled writes must stitch one causal chain
+# producer_submit -> rpc_admit -> admission -> wal_append ->
+# ship_segment -> net_send -> replica_replay -> sub_fanout ->
+# sub_deliver through a kill -9 of a replica AND the leader (with a
+# post-promotion chain in the new epoch), the ack->push freshness
+# decomposition must tile end-to-end latency within 10%, unstamped
+# wire messages must stay byte-identical to the legacy encoding, and
+# every killed child's flight recording must be recoverable from its
+# disk corner. The kept traces are re-checked through trace_inspect
+# --require-chain, same as a human post-mortem would.
+if [ "${RUN_BENCH:-0}" = "1" ] && [ $rc -eq 0 ]; then
+  REFLOW_BENCH_E2ETRACE=1 REFLOW_BENCH_SMOKE=1 JAX_PLATFORMS=cpu \
+    timeout -k 10 590 python bench.py --json-out /tmp/_t1_e2etrace.json \
+    > /dev/null || rc=3
+  python - <<'EOF' || rc=3
+import json
+r = json.load(open("/tmp/_t1_e2etrace.json"))
+assert r["schema"] == "reflow.bench/1" and r["mode"] == "e2etrace", r
+assert r["wire_compat_identical"], r
+assert r["full_chains"] >= 1, r
+assert r["required_chains"] >= 1, r
+assert r["freshness_max_dev_frac"] <= 0.10, r
+assert r["post_promotion_submits"] >= 1, r
+assert "leader" in r["flight_nodes"], r
+print(f"TIER1 e2etrace smoke: {r['full_chains']} full chain(s) across "
+      f"{r['trace_files_merged']} processes, freshness e2e p50 "
+      f"{r['freshness_e2e_p50_us']:.0f}us (tiling dev "
+      f"{100 * r['freshness_max_dev_frac']:.2f}%), "
+      f"{r['post_promotion_submits']} post-promotion sampled "
+      f"submit(s), flight recordings from "
+      f"{len(r['flight_nodes'])} node(s)")
+EOF
+  python tools/trace_inspect.py /tmp/reflow_e2etrace_traces/*-trace.json \
+    --require-chain producer_submit,rpc_admit,admission,wal_append,ship_segment,net_send,replica_replay,sub_fanout,sub_deliver \
+    > /dev/null \
+    || { echo "TIER1: e2etrace require-chain failed"; rc=3; }
+fi
 exit $rc

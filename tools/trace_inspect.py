@@ -35,15 +35,24 @@ tracks; the report folds them into a **replication** section — per
 follower byte flow and NACKs, per replica applied records, replay time,
 and the published-horizon lag after each window.
 
-A trace whose replication spans carry **causality tokens**
-(``obs.trace.mint_cause`` stamped onto shipments while tracing is on)
-gets a **causal chains** section: spans sharing one ``args.cause``
-token are stitched into a single cross-process chain
-(``ship_segment`` → ``net_send`` → ``replica_replay``), with per-link
-latency attribution over the complete chains — the end-to-end
-replication critical path, hop by hop. ``--require-chain a,b,c``
-makes the exit status assert that at least one chain carries all the
-named spans (the fleet bench's smoke check).
+A trace whose spans carry **causality tokens**
+(``obs.trace.mint_cause`` stamped onto writes, shipments, and delta
+frames while tracing is on) gets a **causal chains** section: spans
+sharing a token in ``args.cause`` / ``args.causes`` are stitched into
+cross-process chains, and tokens co-occurring on one span (a chunk's
+own token beside the write tokens it carries) are bridged into one
+group — so a write's journey ``producer_submit`` → ``rpc_admit`` →
+``admission`` → ``wal_append`` → ``ship_segment`` → ``net_send`` →
+``replica_replay`` → ``sub_fanout`` → ``sub_deliver`` reads as a
+single chain even though no single process saw it whole. Groups
+carrying all nine links are **full chains** and feed the **freshness**
+section: ack→delta-visible latency tiled into admission / durability /
+ship / apply / fanout / deliver, with the worst tiling deviation.
+Passing several trace files merges them onto one timeline via their
+``baseTimeS`` anchors (same-host processes share the monotonic
+clock). ``--require-chain a,b,c`` makes the exit status assert that
+at least one causal group carries all the named spans (the fleet
+bench's smoke check).
 
 A trace recorded across a **leader failover** (``serve/failover.py``)
 carries ``failover_elect`` / ``failover_replay`` spans on the
@@ -76,6 +85,26 @@ from reflow_tpu.obs.export import ticket_timelines  # noqa: E402
 from reflow_tpu.obs.trace import STAGES  # noqa: E402
 from reflow_tpu.utils.metrics import percentile  # noqa: E402
 
+#: the canonical follow-the-write chain, producer keystroke to
+#: subscriber-visible answer; a causal group carrying all nine links
+#: is a *full chain* and feeds the freshness decomposition
+FULL_CHAIN = ("producer_submit", "rpc_admit", "admission", "wal_append",
+              "ship_segment", "net_send", "replica_replay",
+              "sub_fanout", "sub_deliver")
+
+#: ack→push freshness stages; each tiles between two chain boundaries
+FRESHNESS_STAGES = ("admission", "durability", "ship", "apply",
+                    "fanout", "deliver")
+
+#: span kinds ONE write's token must itself carry for its ack→deliver
+#: freshness decomposition (the cut points in ``_chain_freshness``).
+#: Deliberately narrower than FULL_CHAIN: ``net_send`` joins a write's
+#: group only through the shipped chunk's own token, and a bridged
+#: group can blob MANY writes together — decomposing over group bounds
+#: would mix cut points from different writes and break the tiling.
+FRESHNESS_SPANS = ("producer_submit", "rpc_admit", "wal_append",
+                   "replica_replay", "sub_fanout", "sub_deliver")
+
 
 def load_events(path: str) -> list:
     with open(path) as f:
@@ -83,13 +112,149 @@ def load_events(path: str) -> list:
     return raw["traceEvents"] if isinstance(raw, dict) else raw
 
 
-def inspect(path: str, require_chain=None) -> dict:
-    """Summarize one trace file; the dict is the ``--json`` output.
-    ``require_chain`` (a list of span names) additionally reports, as
-    ``causal.required_chains``, how many causal chains carry *all* of
-    the named spans — the assertable form of "the end-to-end path
-    survived"."""
-    events = load_events(path)
+def load_traces(paths) -> tuple:
+    """Load + merge one or more trace files onto a shared timeline.
+
+    Every ``export_chrome_trace`` file carries ``baseTimeS`` — the
+    ``perf_counter()`` instant its ``ts=0`` maps to. Processes on one
+    host share that clock, so shifting each file's events by
+    ``(baseTimeS - min(baseTimeS)) * 1e6`` puts all spans on directly
+    comparable microseconds. Per-file ``tid`` namespaces are kept
+    disjoint by rewriting tids to ``(file_index, tid)`` pairs. Returns
+    ``(events, files)`` where ``files`` records each path's base and
+    node id."""
+    loaded, files = [], []
+    for i, path in enumerate(paths):
+        with open(path) as f:
+            raw = json.load(f)
+        if isinstance(raw, dict):
+            events = raw.get("traceEvents", [])
+            base = float(raw.get("baseTimeS") or 0.0)
+            node = raw.get("node")
+        else:
+            events, base, node = raw, 0.0, None
+        files.append({"path": path, "base_time_s": base, "node": node})
+        loaded.append((i, events, base))
+    base0 = min((f["base_time_s"] for f in files), default=0.0)
+    merged = []
+    for i, events, base in loaded:
+        off_us = (base - base0) * 1e6
+        for ev in events:
+            e = dict(ev)
+            if "tid" in e:
+                e["tid"] = (i, e["tid"])
+            if e.get("ph") == "X":
+                e["ts"] = float(e.get("ts", 0.0)) + off_us
+            merged.append(e)
+    return merged, files
+
+
+def read_report(obj: dict) -> dict:
+    """Normalize a ``--json`` report across schema versions: a
+    ``reflow.trace_inspect/1`` report (single file, token-keyed chains,
+    no freshness section) reads back with the /2 keys defaulted, so
+    downstream consumers can be written once against /2."""
+    out = dict(obj)
+    out.setdefault("schema", "reflow.trace_inspect/1")
+    out.setdefault("freshness", None)
+    if not out.get("trace_files"):
+        tf = out.get("trace_file")
+        out["trace_files"] = [tf] if tf else []
+    ca = out.get("causal")
+    if ca is not None:
+        ca.setdefault("groups", ca.get("chains", 0))
+        ca.setdefault("full_chains", 0)
+    return out
+
+
+def _chain_freshness(bounds) -> tuple:
+    """One full chain's ack→deliver decomposition from its per-span
+    time bounds: ``(stage_durs_us, e2e_us, deviation_frac)``. The six
+    stages tile the boundaries producer_submit.start → first
+    rpc_admit.end (a lost ack's dedup re-admit lands later) →
+    wal_append.end → replica_replay.start → min(replica_replay.end,
+    sub_fanout.end) → sub_fanout.end → sub_deliver.end; a stage going
+    negative (clock
+    skew between merged files) is clamped to 0 and shows up in the
+    deviation instead of silently corrupting a neighbor."""
+    def _first_end(b):
+        # min end when tracked (3-element bounds); a 2-element bound
+        # (older report data, hand-built tests) falls back to max end
+        return b[2] if len(b) > 2 else b[1]
+
+    # the hub fans out synchronously inside the replay batch's span
+    # (the window-retire callback), so replica_replay can CLOSE after
+    # the push — even after the subscriber recorded delivery. The
+    # first completed push therefore bounds apply completion from
+    # above; taking the min keeps the cut sequence monotone instead
+    # of charging the replay span's trailing bookkeeping to a
+    # negative fanout stage.
+    apply_done = min(bounds["replica_replay"][1],
+                     bounds["sub_fanout"][1])
+    cuts = (bounds["producer_submit"][0],
+            _first_end(bounds["rpc_admit"]),
+            bounds["wal_append"][1],
+            bounds["replica_replay"][0],
+            apply_done,
+            bounds["sub_fanout"][1],
+            bounds["sub_deliver"][1])
+    raw = {name: cuts[i + 1] - cuts[i]
+           for i, name in enumerate(FRESHNESS_STAGES)}
+    stages = {name: max(0.0, v) for name, v in raw.items()}
+    e2e = cuts[-1] - cuts[0]
+    dev = (abs(sum(stages.values()) - e2e) / e2e) if e2e > 0 else 0.0
+    return stages, e2e, dev, raw
+
+
+def _freshness_summary(full_chains):
+    """Aggregate the per-write decompositions of every ``(token, chain)``
+    whose chain carries all of ``FRESHNESS_SPANS``; None when no write's
+    chain is complete enough to decompose. ``worst`` names the chain
+    with the largest tiling deviation and its unclamped stage deltas —
+    a negative raw delta fingers the cut whose ordering broke."""
+    if not full_chains:
+        return None
+    per_stage: dict = {s: [] for s in FRESHNESS_STAGES}
+    e2e_list, devs = [], []
+    worst = None
+    for tok, ch in full_chains:
+        stages, e2e, dev, raw = _chain_freshness(ch["bounds"])
+        for s, v in stages.items():
+            per_stage[s].append(v)
+        e2e_list.append(e2e)
+        devs.append(dev)
+        if worst is None or dev > worst["dev_frac"]:
+            worst = {"token": tok, "e2e_us": round(e2e, 3),
+                     "dev_frac": round(dev, 6),
+                     "raw_stage_us": {s: round(v, 3)
+                                      for s, v in raw.items()}}
+    mean_e2e = sum(e2e_list) / len(e2e_list)
+    out_stages = {}
+    for s in FRESHNESS_STAGES:
+        vals = per_stage[s]
+        mean = sum(vals) / len(vals)
+        out_stages[s] = {
+            "p50_us": round(percentile(vals, 50), 3),
+            "p99_us": round(percentile(vals, 99), 3),
+            "mean_share": (round(mean / mean_e2e, 4)
+                           if mean_e2e else 0.0)}
+    return {"chains": len(full_chains),
+            "stages": out_stages,
+            "e2e_p50_us": round(percentile(e2e_list, 50), 3),
+            "e2e_p99_us": round(percentile(e2e_list, 99), 3),
+            "max_dev_frac": round(max(devs), 6),
+            "worst": worst}
+
+
+def inspect(path, require_chain=None) -> dict:
+    """Summarize one trace file (or a list of them, merged onto a
+    shared timeline via ``baseTimeS``); the dict is the ``--json``
+    output. ``require_chain`` (a list of span names) additionally
+    reports, as ``causal.required_chains``, how many causal groups
+    carry *all* of the named spans — the assertable form of "the
+    end-to-end path survived"."""
+    paths = [path] if isinstance(path, str) else list(path)
+    events, files = load_traces(paths)
     by_name: dict = defaultdict(list)
     tracks = set()
     # numeric tid -> track name, from the thread_name metadata events
@@ -132,23 +297,68 @@ def inspect(path: str, require_chain=None) -> dict:
                  "reconnects": 0, "reconnect_ms": 0.0,
                  "last_state": None})
     # causal chains (obs.trace.mint_cause): spans sharing one
-    # args.cause token are one shipment's cross-process journey —
-    # chains[token] = {span name -> [durs]}, plus the chain's time span
+    # args.cause token are one write's cross-process journey —
+    # chains[token] = {span name -> [durs]}, per-name time bounds, and
+    # the chain's overall span. A span may carry several tokens (one
+    # args.cause plus an args.causes list — e.g. a shipped chunk's own
+    # token alongside the write tokens it carries); tokens co-occurring
+    # on one span are bridged into a single *group* (union-find), which
+    # is how a write token meets the chunk token its bytes rode in
+    # net_send.
     chains: dict = defaultdict(
-        lambda: {"links": defaultdict(list), "t0": None, "t1": None})
+        lambda: {"links": defaultdict(list), "bounds": {},
+                 "t0": None, "t1": None})
+    uf_parent: dict = {}
+
+    def _find(t):
+        r = t
+        while uf_parent.setdefault(r, r) != r:
+            r = uf_parent[r]
+        while uf_parent[t] != r:
+            uf_parent[t], t = r, uf_parent[t]
+        return r
+
+    def _union(a, b):
+        ra, rb = _find(a), _find(b)
+        if ra != rb:
+            uf_parent[rb] = ra
     for ev in events:
         if ev.get("ph") == "X":
             by_name[ev.get("name", "?")].append(float(ev.get("dur", 0.0)))
             tracks.add(ev.get("tid"))
-            cause = (ev.get("args") or {}).get("cause")
-            if cause:
-                ch = chains[cause]
+            a = ev.get("args") or {}
+            tokens = []
+            if a.get("cause"):
+                tokens.append(a["cause"])
+            for tok in a.get("causes") or ():
+                if tok not in tokens:
+                    tokens.append(tok)
+            if tokens:
                 ts = float(ev.get("ts", 0.0))
                 dur = float(ev.get("dur", 0.0))
-                ch["links"][ev.get("name", "?")].append(dur)
-                ch["t0"] = ts if ch["t0"] is None else min(ch["t0"], ts)
-                ch["t1"] = (ts + dur if ch["t1"] is None
-                            else max(ch["t1"], ts + dur))
+                name = ev.get("name", "?")
+                for tok in tokens:
+                    _find(tok)
+                    ch = chains[tok]
+                    ch["links"][name].append(dur)
+                    b = ch["bounds"].get(name)
+                    if b is None:
+                        # [min start, max end, min end]: a resubmitted
+                        # write can carry several same-name spans (the
+                        # dedup re-admit after an ack was lost); cuts
+                        # that mean "first time this happened" read
+                        # the min end
+                        ch["bounds"][name] = [ts, ts + dur, ts + dur]
+                    else:
+                        b[0] = min(b[0], ts)
+                        b[1] = max(b[1], ts + dur)
+                        b[2] = min(b[2], ts + dur)
+                    ch["t0"] = ts if ch["t0"] is None \
+                        else min(ch["t0"], ts)
+                    ch["t1"] = (ts + dur if ch["t1"] is None
+                                else max(ch["t1"], ts + dur))
+                for tok in tokens[1:]:
+                    _union(tokens[0], tok)
             if ev.get("name") == "device_dispatch":
                 dev = (ev.get("args") or {}).get("device") or "(default)"
                 dev_busy[dev] += float(ev.get("dur", 0.0))
@@ -323,26 +533,56 @@ def inspect(path: str, require_chain=None) -> dict:
                 "last_state": st["last_state"],
             }
     causal = None
+    freshness = None
     if chains:
-        # the canonical replication chain; a chain carrying all three
+        # fold token-keyed chains into bridged groups (union-find roots)
+        groups: dict = {}
+        for tok, ch in chains.items():
+            g = groups.get(_find(tok))
+            if g is None:
+                groups[_find(tok)] = g = {
+                    "links": defaultdict(list), "bounds": {},
+                    "t0": None, "t1": None, "tokens": []}
+            g["tokens"].append(tok)
+            for name, durs in ch["links"].items():
+                g["links"][name].extend(durs)
+            for name, b in ch["bounds"].items():
+                gb = g["bounds"].get(name)
+                if gb is None:
+                    g["bounds"][name] = list(b)
+                else:
+                    gb[0] = min(gb[0], b[0])
+                    gb[1] = max(gb[1], b[1])
+                    if len(gb) > 2 and len(b) > 2:
+                        gb[2] = min(gb[2], b[2])
+            if ch["t0"] is not None:
+                g["t0"] = ch["t0"] if g["t0"] is None \
+                    else min(g["t0"], ch["t0"])
+                g["t1"] = ch["t1"] if g["t1"] is None \
+                    else max(g["t1"], ch["t1"])
+        # the canonical replication chain; a group carrying all three
         # links is "complete" — per-link attribution is computed over
         # those, so partial chains (dropped shipment, wrapped ring)
         # can't skew the hop shares
         chain_links = ("ship_segment", "net_send", "replica_replay")
-        complete = {tok: ch for tok, ch in chains.items()
-                    if all(name in ch["links"] for name in chain_links)}
+        complete = [g for g in groups.values()
+                    if all(name in g["links"] for name in chain_links)]
+        full = [g for g in groups.values()
+                if all(name in g["links"] for name in FULL_CHAIN)]
         link_us: dict = defaultdict(float)
         link_count: dict = defaultdict(int)
         e2e_us_list = []
-        for ch in complete.values():
-            e2e_us_list.append((ch["t1"] or 0.0) - (ch["t0"] or 0.0))
-            for name, durs in ch["links"].items():
+        for g in complete:
+            e2e_us_list.append((g["t1"] or 0.0) - (g["t0"] or 0.0))
+            for name, durs in g["links"].items():
                 link_us[name] += sum(durs)
                 link_count[name] += len(durs)
         total_link_us = sum(link_us.values())
         causal = {
             "chains": len(chains),
+            "groups": len(groups),
             "complete_chains": len(complete),
+            "full_chains": len(full),
             "links": {
                 name: {"spans": link_count[name],
                        "total_ms": round(us / 1e3, 3),
@@ -356,8 +596,14 @@ def inspect(path: str, require_chain=None) -> dict:
         }
         if require_chain:
             causal["required_chains"] = sum(
-                1 for ch in chains.values()
-                if all(name in ch["links"] for name in require_chain))
+                1 for g in groups.values()
+                if all(name in g["links"] for name in require_chain))
+        # freshness decomposes ONE write's journey, so it is computed
+        # over token-keyed chains, never bridged groups (see
+        # FRESHNESS_SPANS for why)
+        freshness = _freshness_summary(
+            [(tok, ch) for tok, ch in chains.items()
+             if all(name in ch["links"] for name in FRESHNESS_SPANS)])
     failover = None
     if failover_events or fence_rejects:
         failover = {
@@ -371,10 +617,13 @@ def inspect(path: str, require_chain=None) -> dict:
             "events": failover_events,
         }
     return {
-        "schema": "reflow.trace_inspect/1",
-        "trace_file": path,
+        "schema": "reflow.trace_inspect/2",
+        "trace_file": paths[0],
+        "trace_files": paths,
+        "files": files,
         "events": sum(len(d) for d in by_name.values()),
         "tracks": len(tracks),
+        "freshness": freshness,
         "durability": durability,
         "failover": failover,
         "window_dispatch_frac": window_dispatch_frac,
@@ -451,13 +700,25 @@ def _print_human(s: dict) -> None:
                   f"state={d['last_state']}")
     ca = s.get("causal")
     if ca:
-        print(f"causal chains: {ca['complete_chains']}/{ca['chains']} "
-              f"complete — e2e p50 {ca['chain_e2e_p50_us']:.1f}us "
+        print(f"causal chains: {ca['complete_chains']}/"
+              f"{ca.get('groups', ca['chains'])} replication-complete, "
+              f"{ca.get('full_chains', 0)} full submit→deliver — "
+              f"e2e p50 {ca['chain_e2e_p50_us']:.1f}us "
               f"p99 {ca['chain_e2e_p99_us']:.1f}us")
         for name, d in ca["links"].items():
             print(f"  link {name}: {d['spans']} span(s) "
                   f"{d['total_ms']:.2f}ms ({100 * d['share']:.1f}% of "
                   f"chain link time)")
+    fr = s.get("freshness")
+    if fr:
+        print(f"freshness: {fr['chains']} full chain(s) — ack→deliver "
+              f"p50 {fr['e2e_p50_us']:.1f}us p99 {fr['e2e_p99_us']:.1f}us "
+              f"(tiling deviation max {100 * fr['max_dev_frac']:.2f}%)")
+        for name in FRESHNESS_STAGES:
+            d = fr["stages"][name]
+            print(f"  {name:<12} p50 {d['p50_us']:>10.1f}us "
+                  f"p99 {d['p99_us']:>10.1f}us "
+                  f"{100 * d['mean_share']:>6.1f}%")
     fo = s.get("failover")
     if fo:
         rej = ", ".join(f"{v} {k}(s)"
@@ -498,7 +759,9 @@ def _print_human(s: dict) -> None:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("trace")
+    ap.add_argument("trace", nargs="+",
+                    help="trace file(s); several are merged onto one "
+                         "timeline via their baseTimeS anchors")
     ap.add_argument("--json", action="store_true",
                     help="print the summary as one JSON line")
     ap.add_argument("--require-chain", metavar="SPANS",
