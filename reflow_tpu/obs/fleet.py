@@ -261,6 +261,17 @@ class FleetAggregator:
             "sub_freshness_p50": _num(gauges.get("subs.freshness_p50")),
             "sub_freshness_p99": _num(gauges.get("subs.freshness_p99")),
             "flight_events": _num(gauges.get("flight.events_total")),
+            # tiled maintenance (REFLOW_TILE_BYTES > 0): worst resident
+            # tile across this node's compactor/chain, published
+            # snapshot tiles across its replicas
+            "tile_peak_bytes": (max(_suffix_values(
+                gauges, ".peak_tile_bytes").values())
+                if _suffix_values(gauges, ".peak_tile_bytes")
+                else None),
+            "snapshot_tiles": (int(sum(_suffix_values(
+                gauges, ".snapshot_tiles").values()))
+                if _suffix_values(gauges, ".snapshot_tiles")
+                else None),
         }
         brownout = {k: v for k, v in gauges.items() if "brownout" in k}
         if brownout:
@@ -355,6 +366,10 @@ class FleetAggregator:
                    if e["sub_freshness_p99"] is not None]
         flight_ev = [e["flight_events"] for e in nodes.values()
                      if e["flight_events"] is not None]
+        tile_peaks = [e["tile_peak_bytes"] for e in nodes.values()
+                      if e["tile_peak_bytes"] is not None]
+        snap_tiles = [e["snapshot_tiles"] for e in nodes.values()
+                      if e["snapshot_tiles"] is not None]
         link_states: Dict[str, int] = {}
         for e in nodes.values():
             for state in e["conn_states"].values():
@@ -382,6 +397,9 @@ class FleetAggregator:
                                    if sub_f99 else None),
             "flight.events_total": (int(sum(flight_ev))
                                     if flight_ev else None),
+            "tile_peak_bytes": max(tile_peaks) if tile_peaks else None,
+            "snapshot_tiles": (int(sum(snap_tiles))
+                               if snap_tiles else None),
             "link_states": link_states,
             "max_age_s": round(max(
                 (e["age_s"] for e in nodes.values()), default=0.0), 4),

@@ -45,9 +45,11 @@ from __future__ import annotations
 import json
 import os
 import pickle
+import shutil
 import threading
 import time
-from typing import Dict, List, NamedTuple, Optional, Tuple
+import zlib
+from typing import Dict, List, NamedTuple, Optional, Set, Tuple
 
 import numpy as np
 
@@ -55,6 +57,8 @@ from reflow_tpu.obs import flight as _flight
 from reflow_tpu.obs import trace as _trace
 from reflow_tpu.obs.registry import REGISTRY
 from reflow_tpu.scheduler import DirtyScheduler
+from reflow_tpu.utils import tiles as _t
+from reflow_tpu.utils.config import env_int
 from reflow_tpu.utils.runtime import named_lock
 from reflow_tpu.wal.log import (_MAGIC, LogPosition, WalError, _repair_tail,
                                 _seg_path, list_segments)
@@ -62,10 +66,15 @@ from reflow_tpu.wal.recovery import replay_records
 from reflow_tpu.wal.ship import (ShipAck, Shipment, ShipNack, iter_frames,
                                  record_causes)
 
-__all__ = ["ReplicaScheduler", "CURSOR_FILE"]
+__all__ = ["ReplicaScheduler", "CURSOR_FILE", "TILE_UNIT_SCHEMA"]
 
 CURSOR_FILE = "cursor.json"
 CURSOR_SCHEMA = "reflow.replica_cursor/1"
+#: one checkpoint file shipped as an independently CRC-framed unit
+#: (wal/ship.py ``_bootstrap_tiles`` <-> ``receive_ckpt_tile``)
+TILE_UNIT_SCHEMA = "reflow.tile_ship/1"
+#: staging directory for an in-flight tile-unit bootstrap transfer
+_STAGE_DIR = "bootstrap-ckpt"
 
 
 class _Snapshot(NamedTuple):
@@ -83,6 +92,39 @@ class _Snapshot(NamedTuple):
     index: Dict[tuple, float]
 
 
+class _Tile(NamedTuple):
+    """One immutable key-range shard of a tiled snapshot. ``gen`` is the
+    content generation: it bumps only when the tile is rebuilt, so two
+    horizons sharing a gen share the *same* array objects (zero-copy
+    reuse for untouched key ranges — the BENCH_r02 preload fix)."""
+
+    lo: int
+    hi: int
+    gen: int
+    keys: List[tuple]
+    weights: np.ndarray
+    values: Optional[np.ndarray]
+    index: Dict[tuple, float]
+
+
+class _TileSnap(NamedTuple):
+    """Frozen tiled read state at one published horizon: a bucket-range
+    plan plus one :class:`_Tile` per range. ``top_k`` argpartitions each
+    tile and merges at most k candidates per tile; the full state is
+    never concatenated into one array."""
+
+    horizon: int
+    plan: Tuple[Tuple[int, int], ...]
+    tiles: Tuple[_Tile, ...]
+
+
+def _row_bytes(kv) -> int:
+    """Histogram estimate for one view row ``(key, value)``."""
+    if isinstance(kv, tuple) and len(kv) == 2:
+        return _t.approx_row_bytes(kv[0], kv[1])
+    return _t.approx_row_bytes(kv, None)
+
+
 class ReplicaScheduler:
     """A follower that replays shipped WAL windows into its own
     ``DirtyScheduler`` and serves snapshot reads at a published horizon.
@@ -95,7 +137,8 @@ class ReplicaScheduler:
     wants — views are host Counters either way."""
 
     def __init__(self, graph, replica_dir: str, *, executor=None,
-                 name: Optional[str] = None) -> None:
+                 name: Optional[str] = None,
+                 tile_bytes: Optional[int] = None) -> None:
         self.graph = graph
         self.replica_dir = replica_dir
         self.mirror_dir = os.path.join(replica_dir, "wal")
@@ -130,6 +173,19 @@ class ReplicaScheduler:
         self._metric_names: List[Tuple[object, str]] = []
         #: optional SubscriptionHub fed by _apply_staged (attach_hub)
         self._hub = None
+        #: snapshot tiling budget; 0 (the default) keeps the monolithic
+        #: per-sink snapshot arrays byte-for-byte unchanged
+        self.tile_bytes = env_int("REFLOW_TILE_BYTES") \
+            if tile_bytes is None else int(tile_bytes)
+        #: per-sink dirty bucket sets since that sink's last snapshot
+        #: build; a ``None`` value means "everything dirty" (rebase,
+        #: bootstrap, unreliable history) and forces a full rebuild
+        self._dirty: Dict[str, Optional[Set[int]]] = {}
+        self.snapshot_tile_builds = 0
+        self.snapshot_tiles_reused = 0
+        #: unit indices staged for the in-flight tile bootstrap transfer
+        self._tile_units_seen: Set[int] = set()
+        self.tile_units_received = 0
         self._restore()
 
     # -- transport surface (the watermark handshake) -----------------------
@@ -171,6 +227,7 @@ class ReplicaScheduler:
             self._horizon = self.sched._tick
             self._staged.clear()
             self._snapshots = {}
+            self._dirty = dict.fromkeys(self.sched.sink_views, None)
             self.bootstraps += 1
         self.checkpoint()
         if self._hub is not None:
@@ -300,11 +357,33 @@ class ReplicaScheduler:
         self.windows_applied += ticks
         self._applied = window[-1][1]
         self._horizon = self.sched._tick
-        self._snapshots = {}
+        results = tuple(self.sched.history[hist0:])
+        reliable = len(results) == self._horizon - from_h
+        if self.tile_bytes > 0:
+            if reliable:
+                # accumulate dirty buckets from the window's columnar
+                # deltas: the next snapshot build rebuilds only tiles
+                # owning a touched bucket and reuses the rest by identity
+                for res in results:
+                    for sname, d in res.sink_deltas.items():
+                        cur = self._dirty.get(sname, set())
+                        if cur is None:
+                            continue  # already all-dirty
+                        for kk, vv, _w in d.rows():
+                            cur.add(_t.bucket_of((kk, vv)))
+                        self._dirty[sname] = cur
+            else:
+                # restored state or trimmed history — per-key deltas
+                # can't be trusted; next build starts from scratch
+                self._dirty = dict.fromkeys(self.sched.sink_views, None)
+            # keep stale tiled snapshots: they seed zero-copy reuse
+            self._snapshots = {n: s for n, s in self._snapshots.items()
+                               if isinstance(s, _TileSnap)}
+        else:
+            self._snapshots = {}
         hub = self._hub
         if hub is not None and self._horizon > from_h:
-            results = tuple(self.sched.history[hist0:])
-            if len(results) == self._horizon - from_h:
+            if reliable:
                 causes: List[str] = []
                 if _trace.ENABLED:
                     for _p, _e, r in window:
@@ -436,12 +515,14 @@ class ReplicaScheduler:
         on a shipment (0 when fully caught up)."""
         return max(0, self._leader_tick - self._horizon)
 
-    def _snapshot(self, sink) -> _Snapshot:
+    def _snapshot(self, sink):
         name = sink if isinstance(sink, str) else sink.name
         snap = self._snapshots.get(name)
         h = self._horizon
         if snap is not None and snap.horizon == h:
             return snap
+        if self.tile_bytes > 0:
+            return self._snapshot_tiled(name)
         with self._lock:
             snap = self._snapshots.get(name)
             if snap is None or snap.horizon != self._horizon:
@@ -463,6 +544,120 @@ class ReplicaScheduler:
                 self._snapshots[name] = snap
         return snap
 
+    # -- tiled snapshots (REFLOW_TILE_BYTES > 0) ---------------------------
+
+    @staticmethod
+    def _build_tile(items, lo: int, hi: int, gen: int) -> _Tile:
+        try:
+            values = np.asarray([kv[1] for kv, _ in items],
+                                dtype=np.float64)
+        except (TypeError, ValueError, IndexError):
+            values = None
+        if values is not None and values.ndim != 1:
+            values = None
+        return _Tile(lo, hi, gen,
+                     [kv for kv, _ in items],
+                     np.asarray([w for _, w in items], dtype=np.float64),
+                     values, dict(items))
+
+    def _build_all_tiles(self, view, h: int) -> _TileSnap:
+        """Full build: histogram the live view into buckets, plan tiles
+        under the budget, materialize each tile once."""
+        buckets: List[list] = [[] for _ in range(_t.N_BUCKETS)]
+        bbytes = [0.0] * _t.N_BUCKETS
+        for kv, w in view.items():
+            if w == 0:
+                continue
+            b = _t.bucket_of(kv)
+            buckets[b].append((kv, w))
+            bbytes[b] += _row_bytes(kv)
+        plan = tuple(_t.plan_tiles(bbytes, self.tile_bytes))
+        tiles = []
+        for lo, hi in plan:
+            items = [it for b in range(lo, hi) for it in buckets[b]]
+            tiles.append(self._build_tile(items, lo, hi, 1))
+            self.snapshot_tile_builds += 1
+        return _TileSnap(h, plan, tuple(tiles))
+
+    def _snapshot_tiled(self, name: str) -> _TileSnap:
+        with self._lock:
+            snap = self._snapshots.get(name)
+            h = self._horizon
+            if isinstance(snap, _TileSnap) and snap.horizon == h:
+                return snap
+            view = self.sched.sink_views[name]
+            prev = snap if isinstance(snap, _TileSnap) else None
+            dirty = self._dirty.get(name, set())
+            if prev is None or dirty is None:
+                snap = self._build_all_tiles(view, h)
+            elif not dirty:
+                # no delta touched this sink: every tile reused as-is
+                self.snapshot_tiles_reused += len(prev.tiles)
+                snap = prev._replace(horizon=h)
+            else:
+                snap = self._rebuild_dirty(view, h, prev, dirty)
+            self._dirty[name] = set()
+            self._snapshots[name] = snap
+            return snap
+
+    def _rebuild_dirty(self, view, h: int, prev: _TileSnap,
+                       dirty: Set[int]) -> _TileSnap:
+        """Rebuild only the tiles owning a dirty bucket; clean tiles are
+        carried over by identity (same array objects, same gen)."""
+        dirty_tiles = {i for i, (lo, hi) in enumerate(prev.plan)
+                       if any(lo <= b < hi for b in dirty)}
+        if not dirty_tiles:
+            self.snapshot_tiles_reused += len(prev.tiles)
+            return prev._replace(horizon=h)
+        per: Dict[int, list] = {i: [] for i in dirty_tiles}
+        est: Dict[int, float] = {i: 0.0 for i in dirty_tiles}
+        for kv, w in view.items():
+            if w == 0:
+                continue
+            i = _t.owning_tile(prev.plan, _t.bucket_of(kv))
+            if i in per:
+                per[i].append((kv, w))
+                est[i] += _row_bytes(kv)
+        for i in dirty_tiles:
+            lo, hi = prev.plan[i]
+            if est[i] > 2 * self.tile_bytes and hi - lo > 1:
+                # a rebuilt tile blew past the enforced bound and can
+                # still be split — replan the whole sink
+                return self._build_all_tiles(view, h)
+        tiles = list(prev.tiles)
+        for i in dirty_tiles:
+            lo, hi = prev.plan[i]
+            tiles[i] = self._build_tile(per[i], lo, hi,
+                                        prev.tiles[i].gen + 1)
+            self.snapshot_tile_builds += 1
+        self.snapshot_tiles_reused += len(prev.tiles) - len(dirty_tiles)
+        return _TileSnap(h, prev.plan, tuple(tiles))
+
+    def _top_k_tiled(self, snap: _TileSnap, k: int, by: str):
+        if by not in ("weight", "value"):
+            raise ValueError(f"by={by!r}: expected 'weight' or 'value'")
+        cands: List[Tuple[float, tuple, float]] = []
+        for t in snap.tiles:
+            n = len(t.keys)
+            if n == 0:
+                continue
+            if by == "value":
+                if t.values is None:
+                    raise ValueError(
+                        f"sink has non-numeric values; "
+                        f"top_k(by='value') needs scalars")
+                rank = t.values
+            else:
+                rank = t.weights
+            kk = min(int(k), n)
+            idx = np.argpartition(rank, n - kk)[n - kk:]
+            for i in idx:
+                cands.append((float(rank[i]), t.keys[int(i)],
+                              float(t.weights[i])))
+        cands.sort(key=lambda c: c[0], reverse=True)
+        return (max(snap.horizon, 0),
+                [(key, w) for _r, key, w in cands[:int(k)]])
+
     def top_k(self, sink, k: int, *, by: str = "weight",
               ) -> Tuple[int, List[Tuple[tuple, float]]]:
         """Top ``k`` sink entries at the snapshot's horizon:
@@ -471,8 +666,12 @@ class ReplicaScheduler:
         by the row's scalar value — the natural order for unique-keyed
         aggregate sinks, where the count lives in the value and every
         live row has weight 1. The hot path is a lock-free argpartition
-        over frozen arrays."""
+        over frozen arrays. With ``REFLOW_TILE_BYTES`` set, each tile is
+        argpartitioned independently and at most k candidates per tile
+        are merged — the full state is never concatenated."""
         snap = self._snapshot(sink)
+        if isinstance(snap, _TileSnap):
+            return self._top_k_tiled(snap, k, by)
         n = len(snap.keys)
         if n == 0:
             return max(snap.horizon, 0), []
@@ -493,15 +692,72 @@ class ReplicaScheduler:
 
     def lookup(self, sink, key) -> Tuple[int, float]:
         """Weight of one ``(key, value)`` sink entry at the snapshot's
-        horizon (0.0 when absent)."""
+        horizon (0.0 when absent). Tiled snapshots touch only the
+        owning tile's index."""
         snap = self._snapshot(sink)
+        if isinstance(snap, _TileSnap):
+            t = snap.tiles[_t.owning_tile(snap.plan, _t.bucket_of(key))]
+            return max(snap.horizon, 0), float(t.index.get(key, 0.0))
         return max(snap.horizon, 0), float(snap.index.get(key, 0.0))
 
     def view_at(self, sink) -> Tuple[int, Dict[tuple, float]]:
         """Full sink view copy at the snapshot's horizon — parity
         checks and small views; ``top_k`` is the scaling read."""
         snap = self._snapshot(sink)
+        if isinstance(snap, _TileSnap):
+            out: Dict[tuple, float] = {}
+            for t in snap.tiles:
+                out.update(t.index)
+            return max(snap.horizon, 0), out
         return max(snap.horizon, 0), dict(snap.index)
+
+    # -- tile-unit bootstrap (wal/ship.py _bootstrap_tiles) ----------------
+
+    def receive_ckpt_tile(self, unit: dict) -> dict:
+        """Stage one CRC-framed checkpoint unit (one file of the
+        leader's checkpoint directory, tile files included) into
+        ``bootstrap-ckpt/``; on the last unit, anchor on the staged
+        checkpoint exactly as :meth:`bootstrap` would. Returns
+        ``{"ok": True}`` per unit (plus ``"cursor"`` on the last) or
+        ``{"ok": False, "reason": ...}`` — a per-unit NACK, so the
+        shipper re-sends one tile, not the chain."""
+        stage = os.path.join(self.replica_dir, _STAGE_DIR)
+        with self._lock:
+            if unit.get("schema") != TILE_UNIT_SCHEMA:
+                return {"ok": False,
+                        "reason": f"schema {unit.get('schema')!r}"}
+            idx = int(unit.get("idx", -1))
+            if idx == 0:
+                # a new transfer: drop any half-staged earlier attempt
+                shutil.rmtree(stage, ignore_errors=True)
+                self._tile_units_seen = set()
+            payload = unit.get("payload") or b""
+            if (zlib.crc32(payload) & 0xFFFFFFFF) != unit.get("crc"):
+                self.crc_rejects += 1
+                return {"ok": False, "reason": "crc mismatch",
+                        "idx": idx}
+            rel = unit.get("rel") or ""
+            parts = rel.replace("\\", "/").split("/")
+            if not rel or os.path.isabs(rel) or ".." in parts:
+                return {"ok": False, "reason": f"bad relpath {rel!r}"}
+            dest = os.path.join(stage, *parts)
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            with open(dest, "wb") as f:
+                f.write(payload)
+            self._tile_units_seen.add(idx)
+            self.tile_units_received += 1
+            if not unit.get("last"):
+                return {"ok": True}
+            total = int(unit.get("total", 0))
+            if len(self._tile_units_seen) != total:
+                return {"ok": False,
+                        "reason": f"incomplete transfer: "
+                                  f"{len(self._tile_units_seen)}/{total} "
+                                  f"units staged"}
+            cursor = self.bootstrap(stage)
+            shutil.rmtree(stage, ignore_errors=True)
+            self._tile_units_seen = set()
+            return {"ok": True, "cursor": tuple(cursor)}
 
     # -- failover ----------------------------------------------------------
 
@@ -613,6 +869,12 @@ class ReplicaScheduler:
         reg.gauge(f"{base}.epoch", lambda: self._epoch)
         reg.gauge(f"{base}.fence_rejected_shipments",
                   lambda: self.fence_rejected_shipments)
+        reg.gauge(f"{base}.snapshot_tiles",
+                  lambda: sum(len(s.tiles)
+                              for s in self._snapshots.values()
+                              if isinstance(s, _TileSnap)))
+        reg.gauge(f"{base}.snapshot_tiles_reused",
+                  lambda: self.snapshot_tiles_reused)
         self._metric_names.append((reg, base))
 
     def close(self) -> None:

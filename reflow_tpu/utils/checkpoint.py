@@ -42,6 +42,24 @@ still covers the newest element's window if its file is lost.
 Delta file framing mirrors the WAL: ``RFCKD001`` magic, then one
 ``[u32 len][u32 crc32]`` pickled payload — torn bytes are detected the
 same way a torn WAL record is.
+
+Tiled elements (``REFLOW_TILE_BYTES`` > 0, docs/guide.md 'Tiled
+maintenance')
+-------------------------------------------------------------------
+A monolithic element pickles the whole keyed state in one payload —
+O(state) peak on both the writer and any restoring reader. Above the
+tile budget, keyed state (sink views plus host states that are plain
+``dict``/``Counter`` maps) is split by key-range tile
+(:mod:`reflow_tpu.utils.tiles`): a full checkpoint writes
+``tiles/t<tick>-NNN.ckt`` files (``RFCKT001`` magic + one CRC frame
+each) next to a small ``meta.pkl`` that lists them, and a delta element
+becomes a multi-frame ``.ckd`` — frame 0 carries the small fields plus
+a ``"tiles"`` count, then one CRC frame per tile. Restore streams one
+frame at a time (peak extra allocation = the largest single frame,
+tracked in :data:`TILE_IO_STATS`); a torn frame anywhere in a delta
+keeps the ``torn=True`` contract, so a torn *final* tiled delta still
+falls back exactly one chain element. Non-map host states and array
+pytrees stay monolithic in the residual payload.
 """
 
 from __future__ import annotations
@@ -62,6 +80,26 @@ CHAIN_MANIFEST = "chain.json"
 CHAIN_SCHEMA = "reflow.ckpt_chain/1"
 _DELTA_MAGIC = b"RFCKD001"
 _DELTA_HEADER = struct.Struct("<II")
+_TILE_MAGIC = b"RFCKT001"
+_TILE_DIR = "tiles"
+
+#: process-wide high-water marks of tiled checkpoint IO — the largest
+#: single frame pickled on a save and unpickled on a restore. The
+#: tiles bench asserts both stay under 2x the tile budget; reset with
+#: :func:`reset_tile_io_stats` around a measured window.
+TILE_IO_STATS = {"writer_peak_frame_bytes": 0,
+                 "reader_peak_frame_bytes": 0}
+
+
+def reset_tile_io_stats() -> None:
+    TILE_IO_STATS["writer_peak_frame_bytes"] = 0
+    TILE_IO_STATS["reader_peak_frame_bytes"] = 0
+
+
+def _tile_budget() -> int:
+    from reflow_tpu.utils.config import env_int
+
+    return int(env_int("REFLOW_TILE_BYTES") or 0)
 
 
 class CheckpointError(RuntimeError):
@@ -109,7 +147,162 @@ def meta_digest(tick: int, seen_batch_ids) -> int:
     return int.from_bytes(h.digest()[:8], "big")
 
 
-def save_checkpoint(sched, path: str, *, truncate: bool = True) -> None:
+# -- key-range tiled elements ----------------------------------------------
+
+
+def _splittable(st) -> bool:
+    """Only plain key->value maps split by key tile; subclasses with
+    extra invariants (and non-map states) stay in the residual blob."""
+    from collections import Counter
+
+    return type(st) in (dict, Counter)
+
+
+def _cls_name(st) -> str:
+    return "Counter" if type(st).__name__ == "Counter" else "dict"
+
+
+def _make_cls(name: str):
+    from collections import Counter
+
+    return Counter if name == "Counter" else dict
+
+
+def _plan_keyed(maps: List, budget: int):
+    """Tile plan over the union of several key->value maps, or None
+    when everything fits one tile (caller stays monolithic)."""
+    from reflow_tpu.utils import tiles as _t
+
+    bucket_bytes = [0.0] * _t.N_BUCKETS
+    for m in maps:
+        for k, v in m.items():
+            bucket_bytes[_t.bucket_of(k)] += _t.approx_row_bytes(k, v)
+    plan = _t.plan_tiles(bucket_bytes, budget)
+    return plan if len(plan) > 1 else None
+
+
+def _slice_by_tile(maps: Dict, plan) -> List[Dict]:
+    """Per-tile slices of several key->value maps in ONE pass — one
+    ``bucket_of`` per key. Slicing per tile would rescan every map
+    once per tile (quadratic in the tile count: a 64-tile save of an
+    8k-key view costs 512k key hashes instead of 8k). The slices hold
+    references into the already-resident source maps, so this buys
+    time, not memory — the tile bound is on pickled frame bytes."""
+    from reflow_tpu.utils import tiles as _t
+
+    tile_of = [0] * _t.N_BUCKETS
+    for i, (lo, hi) in enumerate(plan):
+        for b in range(lo, hi):
+            tile_of[b] = i
+    out: List[Dict] = [{name: {} for name in maps} for _ in plan]
+    for name, m in maps.items():
+        for k, v in m.items():
+            out[tile_of[_t.bucket_of(k)]][name][k] = v
+    return out
+
+
+def _write_tile_file(path: str, payload: dict) -> int:
+    body = pickle.dumps(payload)
+    TILE_IO_STATS["writer_peak_frame_bytes"] = max(
+        TILE_IO_STATS["writer_peak_frame_bytes"], len(body))
+    frame = (_TILE_MAGIC + _DELTA_HEADER.pack(len(body),
+                                              zlib.crc32(body)) + body)
+    with open(path, "wb") as f:
+        f.write(frame)
+        f.flush()
+        os.fsync(f.fileno())
+    return len(frame)
+
+
+def _read_tile_file(path: str) -> dict:
+    """One tiled-checkpoint frame; raises :class:`CheckpointError`
+    (``torn=True``) on missing/short/CRC-torn bytes — a torn base tile
+    fails the restore loud (the chain base has no fallback)."""
+    try:
+        with open(path, "rb") as f:
+            data = f.read()
+    except OSError as e:
+        raise CheckpointError(f"{path}: missing checkpoint tile ({e})",
+                              torn=True) from e
+    if data[:len(_TILE_MAGIC)] != _TILE_MAGIC:
+        raise CheckpointError(f"{path}: bad tile magic "
+                              f"{data[:len(_TILE_MAGIC)]!r}", torn=True)
+    off = len(_TILE_MAGIC)
+    if off + _DELTA_HEADER.size > len(data):
+        raise CheckpointError(f"{path}: truncated tile header",
+                              torn=True)
+    length, crc = _DELTA_HEADER.unpack_from(data, off)
+    body = data[off + _DELTA_HEADER.size: off + _DELTA_HEADER.size
+                + length]
+    if len(body) < length or zlib.crc32(body) != crc:
+        raise CheckpointError(f"{path}: torn checkpoint tile "
+                              f"({len(body)}/{length} bytes)", torn=True)
+    TILE_IO_STATS["reader_peak_frame_bytes"] = max(
+        TILE_IO_STATS["reader_peak_frame_bytes"], len(body))
+    try:
+        return pickle.loads(body)
+    except Exception as e:  # noqa: BLE001 - framed+CRC-clean yet unloadable
+        raise CheckpointError(f"{path}: unpicklable tile payload "
+                              f"({e})", torn=True) from e
+
+
+def _write_full_tiles(path: str, sched, host: Dict, budget: int,
+                      crash=None) -> Optional[dict]:
+    """Write the keyed state of a full checkpoint as per-tile files.
+    Returns the ``meta["tiled"]`` descriptor, or None when one tile
+    would cover everything (caller stays monolithic). Tile files are
+    named by tick so a crashed save never clobbers the files the
+    current ``meta.pkl`` references; superseded files are reaped by
+    the caller after the new meta lands."""
+    import time
+
+    from reflow_tpu.obs import trace as _trace
+
+    views = {name: c for name, c in sched.sink_views.items()}
+    split_host = {nid: st for nid, st in host.items()
+                  if _splittable(st)}
+    plan = _plan_keyed(list(views.values()) + list(split_host.values()),
+                       budget)
+    if plan is None:
+        return None
+    tile_dir = os.path.join(path, _TILE_DIR)
+    os.makedirs(tile_dir, exist_ok=True)
+    view_slices = _slice_by_tile(views, plan)
+    host_slices = _slice_by_tile(split_host, plan)
+    files: List[str] = []
+    peak = 0
+    for t, (lo, hi) in enumerate(plan):
+        t0 = time.perf_counter()
+        payload = {
+            "range": [lo, hi],
+            "views": view_slices[t],
+            "host": host_slices[t],
+        }
+        rel = os.path.join(_TILE_DIR,
+                           f"t{sched._tick:08d}-{t:03d}.ckt")
+        nbytes = _write_tile_file(os.path.join(path, rel), payload)
+        peak = max(peak, nbytes)
+        files.append(rel)
+        if crash is not None:
+            crash.point("ckpt_tile_full_append")
+        if _trace.ENABLED:
+            _trace.evt("ckpt_tile", t0, time.perf_counter() - t0,
+                       track="checkpoint",
+                       args={"tile": t, "of": len(plan),
+                             "kind": "full", "bytes": nbytes})
+    return {
+        "n": len(plan),
+        "budget": budget,
+        "files": files,
+        "peak_tile_bytes": peak,
+        "views_cls": {name: "Counter" for name in views},
+        "host_cls": {nid: _cls_name(st)
+                     for nid, st in split_host.items()},
+    }
+
+
+def save_checkpoint(sched, path: str, *, truncate: bool = True,
+                    crash=None) -> Dict:
     """Multi-controller: every process calls this collectively with the
     same (shared-filesystem) path — orbax writes each process's
     addressable shards of the global arrays; the host-side meta (tick
@@ -149,6 +342,18 @@ def save_checkpoint(sched, path: str, *, truncate: bool = True) -> None:
         "host_states": pickle.dumps(host),
         "has_array_states": bool(arr),
     }
+    budget = _tile_budget()
+    if budget > 0 and jax.process_index() == 0:
+        tiled = _write_full_tiles(path, sched, host, budget,
+                                  crash=crash)
+        if tiled is not None:
+            # keyed state lives in the tile files; meta keeps only the
+            # residual (non-map host states) and the descriptor
+            meta["sink_views"] = {}
+            meta["host_states"] = pickle.dumps(
+                {nid: st for nid, st in host.items()
+                 if not _splittable(st)})
+            meta["tiled"] = tiled
     # a WAL-backed scheduler (wal/durable.py): everything the log holds
     # up to now is covered by this checkpoint. Rotate so the whole
     # covered history sits in sealed segments, record the fresh
@@ -163,8 +368,26 @@ def save_checkpoint(sched, path: str, *, truncate: bool = True) -> None:
         wal.append({"kind": "ckpt", "tick": sched._tick,
                     "path": os.path.abspath(path)})
     if jax.process_index() == 0:
-        with open(os.path.join(path, "meta.pkl"), "wb") as f:
-            pickle.dump(meta, f)
+        if meta.get("tiled") is not None:
+            # the tiled meta names its tile files: land it atomically,
+            # then reap files no meta references any more
+            mtmp = os.path.join(path, "meta.pkl.tmp")
+            with open(mtmp, "wb") as f:
+                pickle.dump(meta, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(mtmp, os.path.join(path, "meta.pkl"))
+            live = set(meta["tiled"]["files"])
+            tile_dir = os.path.join(path, _TILE_DIR)
+            for fname in os.listdir(tile_dir):
+                if os.path.join(_TILE_DIR, fname) not in live:
+                    try:
+                        os.remove(os.path.join(tile_dir, fname))
+                    except OSError:
+                        pass
+        else:
+            with open(os.path.join(path, "meta.pkl"), "wb") as f:
+                pickle.dump(meta, f)
     if arr:
         import orbax.checkpoint as ocp
 
@@ -176,6 +399,7 @@ def save_checkpoint(sched, path: str, *, truncate: bool = True) -> None:
         from reflow_tpu.wal.log import LogPosition
 
         wal.truncate_until(LogPosition(*meta["wal_pos"]))
+    return meta
 
 
 def load_checkpoint(sched, path: str) -> Dict:
@@ -208,6 +432,21 @@ def _load_full(sched, path: str) -> Dict:
     for name, d in meta["sink_views"].items():
         sched.sink_views[name] = Counter(d)
     states = dict(pickle.loads(meta["host_states"]))
+    tiled = meta.get("tiled")
+    if tiled is not None:
+        # keyed state streams back one tile frame at a time — peak
+        # extra allocation is the largest single frame, not O(state)
+        for name in tiled["views_cls"]:
+            sched.sink_views[name] = Counter()
+        acc: Dict = {nid: {} for nid in tiled["host_cls"]}
+        for rel in tiled["files"]:
+            payload = _read_tile_file(os.path.join(path, rel))
+            for name, kv in payload["views"].items():
+                sched.sink_views[name].update(kv)
+            for nid, kv in payload["host"].items():
+                acc[nid].update(kv)
+        for nid, cls in tiled["host_cls"].items():
+            states[nid] = _make_cls(cls)(acc[nid])
     if meta["has_array_states"]:
         import orbax.checkpoint as ocp
 
@@ -273,37 +512,88 @@ def _write_delta_file(path: str, payload: dict) -> int:
     return len(frame)
 
 
-def _read_delta_file(path: str) -> dict:
-    """Parse one framed delta element; raises :class:`CheckpointError`
-    (``torn=True``) on missing/short/CRC-torn bytes — the condition the
-    chain loader answers by falling back one element."""
+def _scan_delta_frames(path: str) -> List[int]:
+    """Validate every frame of a delta element (magic, lengths, CRCs)
+    WITHOUT keeping payloads resident; returns the byte offset of each
+    frame header. Raises :class:`CheckpointError` (``torn=True``) on
+    any torn byte — validation runs before a single frame is applied,
+    so a torn element never half-mutates the restoring scheduler."""
     try:
-        with open(path, "rb") as f:
-            data = f.read()
+        f = open(path, "rb")
     except OSError as e:
         raise CheckpointError(f"{path}: missing delta element ({e})",
                               torn=True) from e
-    if data[:len(_DELTA_MAGIC)] != _DELTA_MAGIC:
-        raise CheckpointError(f"{path}: bad delta magic "
-                              f"{data[:len(_DELTA_MAGIC)]!r}", torn=True)
-    off = len(_DELTA_MAGIC)
-    if off + _DELTA_HEADER.size > len(data):
-        raise CheckpointError(f"{path}: truncated delta header",
+    with f:
+        magic = f.read(len(_DELTA_MAGIC))
+        if magic != _DELTA_MAGIC:
+            raise CheckpointError(f"{path}: bad delta magic "
+                                  f"{magic!r}", torn=True)
+        size = os.fstat(f.fileno()).st_size
+        off = len(_DELTA_MAGIC)
+        offsets: List[int] = []
+        while off < size:
+            hdr = f.read(_DELTA_HEADER.size)
+            if len(hdr) < _DELTA_HEADER.size:
+                raise CheckpointError(f"{path}: truncated delta "
+                                      f"header", torn=True)
+            length, crc = _DELTA_HEADER.unpack(hdr)
+            body = f.read(length)
+            if len(body) < length:
+                raise CheckpointError(
+                    f"{path}: truncated delta payload ({len(body)}/"
+                    f"{length} bytes)", torn=True)
+            if zlib.crc32(body) != crc:
+                raise CheckpointError(f"{path}: delta CRC mismatch",
+                                      torn=True)
+            offsets.append(off)
+            off += _DELTA_HEADER.size + length
+    if not offsets:
+        raise CheckpointError(f"{path}: empty delta element",
                               torn=True)
-    length, crc = _DELTA_HEADER.unpack_from(data, off)
-    body = data[off + _DELTA_HEADER.size: off + _DELTA_HEADER.size
-                + length]
-    if len(body) < length:
-        raise CheckpointError(
-            f"{path}: truncated delta payload ({len(body)}/{length} "
-            f"bytes)", torn=True)
-    if zlib.crc32(body) != crc:
-        raise CheckpointError(f"{path}: delta CRC mismatch", torn=True)
+    return offsets
+
+
+def _read_frame_at(f, path: str, off: int) -> dict:
+    """One already-CRC-validated frame from an open element file."""
+    f.seek(off)
+    length, _crc = _DELTA_HEADER.unpack(f.read(_DELTA_HEADER.size))
+    body = f.read(length)
+    TILE_IO_STATS["reader_peak_frame_bytes"] = max(
+        TILE_IO_STATS["reader_peak_frame_bytes"], len(body))
     try:
         return pickle.loads(body)
     except Exception as e:  # noqa: BLE001 - framed+CRC-clean yet unloadable
         raise CheckpointError(f"{path}: unpicklable delta payload "
                               f"({e})", torn=True) from e
+
+
+def _read_delta_file(path: str) -> dict:
+    """Parse one framed delta element into a single merged payload
+    (non-streaming convenience — tools and inspection; the chain
+    loader streams instead). Raises :class:`CheckpointError`
+    (``torn=True``) on missing/short/CRC-torn bytes — the condition
+    the chain loader answers by falling back one element."""
+    offsets = _scan_delta_frames(path)
+    with open(path, "rb") as f:
+        payload = _read_frame_at(f, path, offsets[0])
+        ntiles = int(payload.get("tiles", 0) or 0)
+        if ntiles != len(offsets) - 1:
+            raise CheckpointError(
+                f"{path}: tiled delta frame count mismatch "
+                f"({len(offsets) - 1}/{ntiles} tile frames)", torn=True)
+        for off in offsets[1:]:
+            tp = _read_frame_at(f, path, off)
+            for sink, kv in tp["view_deltas"].items():
+                payload.setdefault("view_deltas", {}).setdefault(
+                    sink, {}).update(kv)
+            for nid, ent in tp["host_states"].items():
+                cur = payload.setdefault("_tiled_host", {}).setdefault(
+                    nid, (ent["cls"], {}))
+                cur[1].update(ent["items"])
+        for nid, (cls, items) in payload.pop("_tiled_host", {}).items():
+            payload["host_states"][nid] = pickle.dumps(
+                _make_cls(cls)(items))
+    return payload
 
 
 def _numpyify(tree):
@@ -357,6 +647,34 @@ def _apply_delta(sched, payload: dict) -> None:
         sched._pending[nid].extend(batches)
 
 
+def _apply_delta_tiles(sched, f, path: str, offsets: List[int]) -> None:
+    """Stream a tiled delta's tile frames into the scheduler: view
+    deltas merge per frame (tile key ranges are disjoint), changed
+    splittable host states accumulate their slices and replace the
+    live state whole — the same replace semantics the monolithic
+    delta's pickled blob has."""
+    from collections import Counter
+
+    acc: Dict = {}
+    for off in offsets:
+        tp = _read_frame_at(f, path, off)
+        for sink, kv in tp["view_deltas"].items():
+            view = sched.sink_views.get(sink)
+            if view is None:
+                view = sched.sink_views[sink] = Counter()
+            for k, v in kv.items():
+                if v is None:
+                    view.pop(k, None)
+                else:
+                    view[k] = v
+        for nid, ent in tp["host_states"].items():
+            cur = acc.setdefault(nid, (ent["cls"], {}))
+            cur[1].update(ent["items"])
+    states = sched.executor.states
+    for nid, (cls, items) in acc.items():
+        states[nid] = _make_cls(cls)(items)
+
+
 def load_chain(sched, root: str) -> Dict:
     """Restore a :class:`CheckpointChain` directory: the base full
     checkpoint, then every delta element in manifest order. A broken
@@ -376,15 +694,31 @@ def load_chain(sched, root: str) -> Dict:
     fallback = None
     deltas: List[str] = list(manifest.get("deltas", []))
     for i, dname in enumerate(deltas):
+        dpath = os.path.join(root, dname)
         try:
-            payload = _read_delta_file(os.path.join(root, dname))
-            if payload.get("parent") != prev_name \
-                    or payload.get("base_tick") != sched._tick:
-                raise CheckpointError(
-                    f"{root}/{dname}: broken chain link (parent "
-                    f"{payload.get('parent')!r} @ tick "
-                    f"{payload.get('base_tick')!r}, expected "
-                    f"{prev_name!r} @ tick {sched._tick})")
+            # whole-file CRC validation first (bounded memory), THEN
+            # frame-by-frame apply: a torn element — torn in ANY tile
+            # frame — is detected before a single byte is applied, so
+            # the final-element fallback leaves clean state
+            offsets = _scan_delta_frames(dpath)
+            with open(dpath, "rb") as df:
+                payload = _read_frame_at(df, dpath, offsets[0])
+                ntiles = int(payload.get("tiles", 0) or 0)
+                if ntiles != len(offsets) - 1:
+                    raise CheckpointError(
+                        f"{dpath}: tiled delta frame count mismatch "
+                        f"({len(offsets) - 1}/{ntiles} tile frames)",
+                        torn=True)
+                if payload.get("parent") != prev_name \
+                        or payload.get("base_tick") != sched._tick:
+                    raise CheckpointError(
+                        f"{root}/{dname}: broken chain link (parent "
+                        f"{payload.get('parent')!r} @ tick "
+                        f"{payload.get('base_tick')!r}, expected "
+                        f"{prev_name!r} @ tick {sched._tick})")
+                _apply_delta(sched, payload)
+                if ntiles:
+                    _apply_delta_tiles(sched, df, dpath, offsets[1:])
         except CheckpointError as e:
             if e.torn and i == len(deltas) - 1:
                 # torn tail of the chain: fall back one element, the
@@ -392,7 +726,6 @@ def load_chain(sched, root: str) -> Dict:
                 fallback = str(e)
                 break
             raise
-        _apply_delta(sched, payload)
         if payload.get("wal_pos") is not None:
             wal_pos = tuple(payload["wal_pos"])
         prev_name = dname
@@ -429,7 +762,10 @@ class CheckpointChain:
     it leaves the new one. ``crash`` is a
     :class:`~reflow_tpu.utils.faults.CrashInjector` seam hook
     (``ckpt_full_before_flip`` / ``ckpt_delta_before_flip`` /
-    ``ckpt_delta_after_flip``) for the differential crash tests."""
+    ``ckpt_delta_after_flip``, plus the per-tile seams
+    ``ckpt_tile_full_append`` / ``ckpt_tile_append`` when
+    ``REFLOW_TILE_BYTES`` tiles the elements) for the differential
+    crash tests."""
 
     def __init__(self, root: str, *, delta_every: Optional[int] = None,
                  crash=None):
@@ -444,6 +780,11 @@ class CheckpointChain:
         self.fulls = 0
         self.deltas = 0
         self.delta_bytes = 0
+        #: tile shape of the newest element (0 = monolithic) and the
+        #: largest tile frame any save of this chain ever pickled
+        self.tile_count = 0
+        self.peak_tile_bytes = 0
+        self._metric_names: List = []
         #: what the previous element looked like, for diffing; None
         #: forces the next save to be full (fresh writer, fresh chain)
         self._shadow: Optional[dict] = None
@@ -535,12 +876,15 @@ class CheckpointChain:
         # names this full as the new chain base — a crash between the
         # save and the flip restores the OLD chain, whose last element
         # still needs its replay tail
-        save_checkpoint(sched, path, truncate=False)
+        meta = save_checkpoint(sched, path, truncate=False,
+                               crash=self._crash)
+        tiled = meta.get("tiled")
+        self.tile_count = tiled["n"] if tiled else 0
+        if tiled:
+            self.peak_tile_bytes = max(self.peak_tile_bytes,
+                                       tiled["peak_tile_bytes"])
         wal = getattr(sched, "wal", None)
-        wal_pos = None
-        if wal is not None:
-            with open(os.path.join(path, "meta.pkl"), "rb") as f:
-                wal_pos = pickle.load(f).get("wal_pos")
+        wal_pos = meta.get("wal_pos") if wal is not None else None
         self._crash_point("ckpt_full_before_flip")
         manifest = {
             "schema": CHAIN_SCHEMA,
@@ -550,6 +894,11 @@ class CheckpointChain:
             "wal_pos": list(wal_pos) if wal_pos is not None else None,
             "saves": self.saves + 1,
         }
+        if tiled:
+            manifest["tiles"] = {"count": tiled["n"],
+                                 "budget": tiled["budget"],
+                                 "peak_tile_bytes":
+                                     tiled["peak_tile_bytes"]}
         self._flip_manifest(manifest)
         self._truncate_to(sched, wal_pos)
         self._gc(old)
@@ -580,13 +929,28 @@ class CheckpointChain:
         new_ids = dict(sched._seen_batch_ids)
         added = [b for b in new_ids if b not in shadow["ids"]]
         dropped = len(shadow["ids"]) + len(added) - len(new_ids)
+        budget = _tile_budget()
+        tile_plan = None
+        split_changed: Dict = {}
+        if budget > 0:
+            for nid in host_changed:
+                st = sched.executor.states.get(nid)
+                if st is not None and _splittable(st):
+                    split_changed[nid] = st
+            tile_plan = _plan_keyed(
+                list(view_deltas.values()) + list(split_changed.values()),
+                budget)
+            if tile_plan is None:
+                split_changed = {}
         wal_pos = self._wal_anchor(sched)
         payload = {
             "tick": sched._tick,
             "base_tick": shadow["tick"],
             "parent": shadow["name"],
-            "view_deltas": view_deltas,
-            "host_states": host_changed,
+            "view_deltas": view_deltas if tile_plan is None else {},
+            "host_states": (host_changed if tile_plan is None else
+                            {nid: b for nid, b in host_changed.items()
+                             if nid not in split_changed}),
             "array_states": {nid: t for nid, t in arr_changed.items()},
             "ids_added": added,
             "ids_dropped": max(0, dropped),
@@ -595,8 +959,16 @@ class CheckpointChain:
             "wal_pos": wal_pos,
         }
         name = f"delta-{self.saves:06d}.ckd"
-        nbytes = _write_delta_file(os.path.join(self.root, name),
-                                   payload)
+        if tile_plan is None:
+            self.tile_count = 0
+            nbytes = _write_delta_file(os.path.join(self.root, name),
+                                       payload)
+        else:
+            payload["tiles"] = len(tile_plan)
+            nbytes = self._write_delta_tiles(
+                os.path.join(self.root, name), payload, tile_plan,
+                view_deltas, split_changed)
+            self.tile_count = len(tile_plan)
         self._crash_point("ckpt_delta_before_flip")
         manifest = read_chain_manifest(self.root)
         manifest["deltas"] = list(manifest.get("deltas", [])) + [name]
@@ -604,6 +976,11 @@ class CheckpointChain:
         manifest["wal_pos"] = (list(wal_pos) if wal_pos is not None
                                else None)
         manifest["saves"] = self.saves + 1
+        if tile_plan is not None:
+            manifest["tiles"] = {"count": len(tile_plan),
+                                 "budget": budget,
+                                 "peak_tile_bytes":
+                                     self.peak_tile_bytes}
         self._flip_manifest(manifest)
         self._crash_point("ckpt_delta_after_flip")
         # lag-one truncation: keep the log back to the PREVIOUS
@@ -619,6 +996,80 @@ class CheckpointChain:
                 "wal_pos": wal_pos, "bytes": nbytes,
                 "changed_sources": sorted(
                     list(host_changed) + list(arr_changed))}
+
+    def _write_delta_tiles(self, path: str, header: dict, plan,
+                           view_deltas: Dict,
+                           split_changed: Dict) -> int:
+        """Write a tiled delta element: frame 0 is the small header
+        payload, then one CRC frame per key-range tile. One tile's
+        slice is pickled at a time — writer peak is the largest tile
+        frame, not the whole delta."""
+        import time
+
+        from reflow_tpu.obs import trace as _trace
+
+        peak = 0
+        view_slices = _slice_by_tile(view_deltas, plan)
+        host_slices = _slice_by_tile(split_changed, plan)
+        with open(path, "wb") as f:
+            f.write(_DELTA_MAGIC)
+            n = len(_DELTA_MAGIC)
+            hbody = pickle.dumps(header)
+            f.write(_DELTA_HEADER.pack(len(hbody), zlib.crc32(hbody)))
+            f.write(hbody)
+            n += _DELTA_HEADER.size + len(hbody)
+            for t, (lo, hi) in enumerate(plan):
+                t0 = time.perf_counter()
+                tp = {
+                    "range": [lo, hi],
+                    "view_deltas": view_slices[t],
+                    "host_states": {nid: {"cls": _cls_name(
+                                              split_changed[nid]),
+                                          "items": items}
+                                    for nid, items in
+                                    host_slices[t].items()},
+                }
+                body = pickle.dumps(tp)
+                TILE_IO_STATS["writer_peak_frame_bytes"] = max(
+                    TILE_IO_STATS["writer_peak_frame_bytes"],
+                    len(body))
+                peak = max(peak, len(body))
+                f.write(_DELTA_HEADER.pack(len(body),
+                                           zlib.crc32(body)))
+                f.write(body)
+                n += _DELTA_HEADER.size + len(body)
+                f.flush()
+                self._crash_point("ckpt_tile_append")
+                if _trace.ENABLED:
+                    _trace.evt("ckpt_tile", t0,
+                               time.perf_counter() - t0,
+                               track="checkpoint",
+                               args={"tile": t, "of": len(plan),
+                                     "kind": "delta",
+                                     "bytes": len(body)})
+            f.flush()
+            os.fsync(f.fileno())
+        self.peak_tile_bytes = max(self.peak_tile_bytes, peak)
+        return n
+
+    def publish_metrics(self, registry=None, name: str = "ckpt"
+                        ) -> None:
+        from reflow_tpu.obs.registry import REGISTRY
+
+        reg = registry if registry is not None else REGISTRY
+        reg.gauge(f"{name}.saves", lambda: self.saves)
+        reg.gauge(f"{name}.fulls", lambda: self.fulls)
+        reg.gauge(f"{name}.deltas", lambda: self.deltas)
+        reg.gauge(f"{name}.delta_bytes", lambda: self.delta_bytes)
+        reg.gauge(f"{name}.tile_count", lambda: self.tile_count)
+        reg.gauge(f"{name}.peak_tile_bytes",
+                  lambda: self.peak_tile_bytes)
+        self._metric_names.append((reg, name))
+
+    def close(self) -> None:
+        for reg, name in self._metric_names:
+            reg.unregister_prefix(name)
+        self._metric_names.clear()
 
     def _gc(self, old_manifest: Optional[dict]) -> None:
         """Drop the superseded chain's elements (best-effort; stray

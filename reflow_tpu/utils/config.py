@@ -297,6 +297,30 @@ declare("REFLOW_BENCH_COMPACT_TICKS", "int", None,
         "compact bench batches per producer per leg "
         "(default 480, smoke 160)")
 
+# -- tiled maintenance (docs/guide.md 'Tiled maintenance') ------------------
+
+declare("REFLOW_TILE_BYTES", "int", 0,
+        "key-range tile budget (bytes) for O(state) maintenance: "
+        "compaction folds, checkpoint base/delta elements, and replica "
+        "snapshots process one tile of roughly this many resident "
+        "bytes at a time (enforced peak is 2x: estimate slop plus one "
+        "oversized bucket). 0 (default) disables tiling — all three "
+        "paths run their monolithic code byte-for-byte unchanged")
+declare("REFLOW_TILE_SHIP_RETRIES", "int", 3,
+        "per-tile resend attempts when a bootstrap tile unit is "
+        "NACKed (CRC mismatch on the follower) before the shipper "
+        "falls back to a whole-chain bootstrap")
+declare("REFLOW_BENCH_TILES", "flag", False,
+        "bench mode: tiled maintenance — two identically-fed legs at "
+        "state >= 8x the tile budget; tiled leg must bound compaction "
+        "and checkpoint/restore peak under 2x budget, recover + "
+        "bootstrap with exact parity vs the monolithic leg, survive "
+        "kill -9 at every per-tile crash seam, and match top_k/lookup "
+        "against the untiled snapshot oracle")
+declare("REFLOW_BENCH_TILES_TICKS", "int", None,
+        "tiles bench batches per producer per leg "
+        "(default 320, smoke 120)")
+
 # -- fleet telemetry (docs/guide.md 'Fleet telemetry') ----------------------
 
 declare("REFLOW_FLEET_NODE", "str", None,

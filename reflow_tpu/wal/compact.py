@@ -42,6 +42,21 @@ O(state) now, which is the point.
 Run it from the :class:`~reflow_tpu.serve.control.ControlPlane`
 (``compactor=``): the control loop supervises the compactor thread with
 the same respawn-or-fail-fast budget as the WAL committer.
+
+**Tiled folds** (``REFLOW_TILE_BYTES`` > 0, docs/guide.md 'Tiled
+maintenance'): the monolithic fold holds the whole folded state of the
+range in RAM. Above the tile budget the pass instead runs a cheap
+key-histogram scan, plans contiguous key-range tiles under the budget
+(:mod:`reflow_tpu.utils.tiles`), and folds one [key-range] x
+[segment-range] tile at a time — peak resident fold state is one tile.
+The output segment holds, per source, one zero-row *cover* record
+carrying every original batch id (written first, so a restore point
+inside the fold fails loud before any part applies) and one *part*
+record per tile with a synthetic batch id ``<first_id>#t<k>``; replay
+dedup works unchanged. Tiles append incrementally to the same tmp
+file; a ``<tmp>.progress`` sidecar flips after each tile so a crash
+mid-pass resumes without refolding finished tiles (per-tile
+generations record which pass attempt folded each tile).
 """
 
 from __future__ import annotations
@@ -62,7 +77,9 @@ __all__ = ["WalCompactor", "read_compact_manifest",
 
 COMPACT_MANIFEST_FILE = "compact-manifest.json"
 COMPACT_SCHEMA = "reflow.wal_compact/1"
+PROGRESS_SCHEMA = "reflow.wal_compact_progress/1"
 _TMP_SUFFIX = ".compact"
+_PROGRESS_SUFFIX = ".compact.progress"
 
 
 def read_compact_manifest(wal_dir: str) -> Optional[dict]:
@@ -131,21 +148,32 @@ class _SourceFold:
         self.keys_like = rec["keys"]
         self.values_like = rec["values"]
 
-    def add(self, rec: Dict) -> None:
+    def add(self, rec: Dict, row_filter=None, take_ids: bool = True,
+            take_rows: bool = True) -> None:
+        """Fold one push record in. A tiled pass restricts the fold:
+        ``row_filter(key) -> bool`` keeps only the tile's rows,
+        ``take_ids=False`` leaves batch ids to the cover record, and
+        ``take_rows=False`` (histogram/cover pass) collects only
+        ids/epoch/tick."""
         import numpy as np
 
         self.epoch = max(self.epoch, rec.get("epoch", 0) or 0)
-        ids = rec.get("batch_ids")
-        if ids is None:
-            ids = [rec["batch_id"]] if rec.get("batch_id") else []
-        for b in ids:
-            if b not in self.ids_set:
-                self.ids_set.add(b)
-                self.ids.append(b)
+        if take_ids:
+            ids = rec.get("batch_ids")
+            if ids is None:
+                ids = [rec["batch_id"]] if rec.get("batch_id") else []
+            for b in ids:
+                if b not in self.ids_set:
+                    self.ids_set.add(b)
+                    self.ids.append(b)
+        if not take_rows:
+            return
         keys = np.asarray(rec["keys"])
         values = np.asarray(rec["values"])
         weights = np.asarray(rec["weights"])
         for k, v, w in zip(keys, values, weights):
+            if row_filter is not None and not row_filter(k):
+                continue
             rk = (_scalarize(k), _scalarize(v))
             cell = self.agg.get(rk)
             if cell is None:
@@ -153,14 +181,25 @@ class _SourceFold:
             else:
                 cell[2] += int(w)
 
-    def record(self) -> Dict:
+    def resident_bytes(self) -> int:
+        """Approximate host bytes this fold holds resident — the
+        quantity the tile budget bounds (``compact.peak_tile_bytes``)."""
+        from reflow_tpu.utils.tiles import approx_row_bytes
+
+        return sum(approx_row_bytes(c[0], c[1])
+                   for c in self.agg.values())
+
+    def record(self, batch_id: Optional[str] = None) -> Dict:
+        """The folded record. ``batch_id`` overrides for a tile *part*:
+        the record then carries only that synthetic id (dedup unit =
+        one tile) and the original ids ride the range's cover record."""
         rows = [c for c in self.agg.values() if c[2] != 0]
         rec = {
             "kind": "push",
             "tick": self.first_tick,
             "node": self.nid,
             "node_name": self.name,
-            "batch_id": self.ids[0],
+            "batch_id": batch_id if batch_id is not None else self.ids[0],
             # the folded batch is a SUM with no per-id slice; replay
             # fails loud if a restore point falls inside the fold
             # (wal/recovery.py's partial-ids check keys off this)
@@ -169,7 +208,7 @@ class _SourceFold:
             "values": _col([c[1] for c in rows], self.values_like),
             "weights": _col([c[2] for c in rows], [0]),
         }
-        if len(self.ids) > 1:
+        if batch_id is None and len(self.ids) > 1:
             rec["batch_ids"] = list(self.ids)
         if self.epoch:
             rec["epoch"] = self.epoch
@@ -199,6 +238,7 @@ class WalCompactor:
                  interval_s: Optional[float] = None,
                  min_segments: Optional[int] = None,
                  keep_segments: Optional[int] = None,
+                 tile_bytes: Optional[int] = None,
                  crash=None) -> None:
         from reflow_tpu.utils.config import env_float, env_int
 
@@ -214,6 +254,8 @@ class WalCompactor:
                              else env_int("REFLOW_COMPACT_MIN_SEGMENTS"))
         self.keep_segments = (keep_segments if keep_segments is not None
                               else env_int("REFLOW_COMPACT_KEEP_SEGMENTS"))
+        self.tile_bytes = (tile_bytes if tile_bytes is not None
+                           else env_int("REFLOW_TILE_BYTES"))
         self._crash = crash
         self._lock = named_lock("wal.compact")
         self._stop = threading.Event()
@@ -224,6 +266,8 @@ class WalCompactor:
         self.records_in = 0
         self.records_out = 0
         self.reclaimed_bytes = 0
+        self.tile_folds = 0
+        self.peak_tile_bytes = 0
         self.restarts = 0
         self.last_error: Optional[BaseException] = None
         self._events: List[Dict] = []
@@ -326,6 +370,11 @@ class WalCompactor:
             return None
 
     def _fold_range(self, rng: List[int]) -> Optional[Dict]:
+        if self.tile_bytes and self.tile_bytes > 0:
+            return self._fold_range_tiled(rng)
+        return self._fold_range_mono(rng)
+
+    def _fold_range_mono(self, rng: List[int]) -> Optional[Dict]:
         segs = dict(list_segments(self.wal_dir))
         folds: Dict[int, _SourceFold] = {}
         order: List[int] = []
@@ -369,6 +418,173 @@ class WalCompactor:
         out_seq = rng[0]
         tmp = _seg_path(self.wal_dir, out_seq) + _TMP_SUFFIX
         new_bytes = self._write_segment(tmp, out_records)
+        return self._commit(rng, segs, tmp, new_bytes, orig_bytes,
+                            records_in, len(out_records),
+                            tick_lo, tick_hi, None)
+
+    # -- tiled fold (REFLOW_TILE_BYTES > 0) --------------------------------
+
+    def _fold_range_tiled(self, rng: List[int]) -> Optional[Dict]:
+        """Fold the range one key-range tile at a time: histogram pass
+        -> tile plan -> per-tile fold passes appending to the same tmp
+        segment, with a progress sidecar flipped after every tile so an
+        interrupted pass resumes without refolding finished tiles."""
+        import time
+
+        import numpy as np
+
+        from reflow_tpu.obs import trace as _trace
+        from reflow_tpu.utils import tiles as _t
+
+        budget = int(self.tile_bytes)
+        segs = dict(list_segments(self.wal_dir))
+        # -- histogram pass: per-bucket byte estimate, cover folds
+        # (ids/epoch/tick only — no rows held), passthrough, stats
+        bucket_bytes = [0.0] * _t.N_BUCKETS
+        covers: Dict[int, _SourceFold] = {}
+        order: List[int] = []
+        passthrough: List[Dict] = []
+        records_in = 0
+        orig_bytes = 0
+        tick_lo: Optional[int] = None
+        tick_hi: Optional[int] = None
+        for seq in rng:
+            path = segs[seq]
+            orig_bytes += os.path.getsize(path)
+            seg_records, _torn = _read_segment(path, seq, False)
+            for _pos, rec in seg_records:
+                records_in += 1
+                kind = rec.get("kind")
+                if kind == "push":
+                    nid = rec["node"]
+                    f = covers.get(nid)
+                    if f is None:
+                        f = covers[nid] = _SourceFold(nid, rec)
+                        order.append(nid)
+                    f.add(rec, take_rows=False)
+                    for k, v in zip(np.asarray(rec["keys"]),
+                                    np.asarray(rec["values"])):
+                        bucket_bytes[_t.bucket_of(k)] += \
+                            _t.approx_row_bytes(k, v)
+                elif kind == "tick":
+                    t = rec.get("tick", 0)
+                    tick_lo = t if tick_lo is None else min(tick_lo, t)
+                    tick_hi = t if tick_hi is None else max(tick_hi, t)
+                    passthrough.append(rec)
+                else:
+                    passthrough.append(rec)
+        plan = [[lo, hi] for lo, hi in _t.plan_tiles(bucket_bytes, budget)]
+        if len(plan) <= 1:
+            # state fits one tile: the monolithic fold is the same
+            # work without synthetic ids or a sidecar
+            return self._fold_range_mono(rng)
+        out_seq = rng[0]
+        tmp = _seg_path(self.wal_dir, out_seq) + _TMP_SUFFIX
+        prog_path = _seg_path(self.wal_dir, out_seq) + _PROGRESS_SUFFIX
+        cover_recs = [covers[nid].record() for nid in order
+                      if covers[nid].ids]
+        # -- resume or start: a valid sidecar for this exact range and
+        # plan means finished tiles are already on the tmp segment
+        prog = self._read_progress(prog_path)
+        if not (prog is not None and os.path.exists(tmp)
+                and prog.get("covers") == [rng[0], rng[-1]]
+                and prog.get("plan") == plan):
+            for stale in (tmp, prog_path):
+                if os.path.exists(stale):
+                    os.remove(stale)
+            end = self._append_records(tmp, cover_recs, None)
+            prog = {"schema": PROGRESS_SCHEMA, "covers": [rng[0], rng[-1]],
+                    "plan": plan, "budget": budget, "attempt": 1,
+                    "covers_end": end, "done": []}
+            self._write_progress(prog_path, prog)
+        else:
+            prog["attempt"] = int(prog.get("attempt", 1)) + 1
+        done = {int(d["tile"]): d for d in prog["done"]}
+        end = max([int(prog["covers_end"])]
+                  + [int(d["end"]) for d in done.values()])
+        peak = max([0] + [int(d.get("resident", 0))
+                          for d in done.values()])
+        resumed_tiles = len(done)
+        gens: List[int] = [0] * len(plan)
+        for k, d in done.items():
+            gens[k] = int(d["gen"])
+        parts_out = sum(int(d.get("parts", 0)) for d in done.values())
+        for k, (lo, hi) in enumerate(plan):
+            if k in done:
+                continue
+            t0 = time.perf_counter()
+            in_tile = (lambda key, _lo=lo, _hi=hi:
+                       _lo <= _t.bucket_of(key) < _hi)
+            folds: Dict[int, _SourceFold] = {}
+            torder: List[int] = []
+            for seq in rng:
+                seg_records, _torn = _read_segment(segs[seq], seq, False)
+                for _pos, rec in seg_records:
+                    if rec.get("kind") != "push":
+                        continue
+                    nid = rec["node"]
+                    f = folds.get(nid)
+                    if f is None:
+                        f = folds[nid] = _SourceFold(nid, rec)
+                        torder.append(nid)
+                    f.add(rec, row_filter=in_tile, take_ids=False)
+            resident = sum(folds[nid].resident_bytes() for nid in torder)
+            peak = max(peak, resident)
+            recs = []
+            for nid in torder:
+                f = folds[nid]
+                if any(c[2] != 0 for c in f.agg.values()):
+                    recs.append(f.record(
+                        batch_id=f"{covers[nid].ids[0]}#t{k}"))
+            folds.clear()
+            # append the tile (truncating any torn partial append from
+            # a crashed attempt), then flip the sidecar: the tile is
+            # durable before it is recorded done
+            end = self._append_records(tmp, recs, end)
+            parts_out += len(recs)
+            self._crash_point("compact_tile_before_progress")
+            gens[k] = prog["attempt"]
+            prog["done"].append({"tile": k, "gen": prog["attempt"],
+                                 "end": end, "resident": resident,
+                                 "parts": len(recs)})
+            self._write_progress(prog_path, prog)
+            self._crash_point("compact_tile_after_progress")
+            if _trace.ENABLED:
+                _trace.evt("compact_tile", t0,
+                           time.perf_counter() - t0,
+                           track="wal-compactor",
+                           args={"tile": k, "of": len(plan),
+                                 "buckets": [lo, hi],
+                                 "resident_bytes": resident,
+                                 "parts": len(recs),
+                                 "gen": prog["attempt"]})
+            with self._lock:
+                self.tile_folds += 1
+                self.peak_tile_bytes = max(self.peak_tile_bytes,
+                                           resident)
+        new_bytes = self._append_records(tmp, passthrough, end)
+        records_out = len(cover_recs) + parts_out + len(passthrough)
+        tiles_info = {
+            "n": len(plan),
+            "budget": budget,
+            "peak_tile_bytes": peak,
+            "plan": plan,
+            "gens": gens,
+            "resumed_tiles": resumed_tiles,
+            "attempts": prog["attempt"],
+        }
+        return self._commit(rng, segs, tmp, new_bytes, orig_bytes,
+                            records_in, records_out, tick_lo, tick_hi,
+                            tiles_info)
+
+    def _commit(self, rng: List[int], segs: Dict[int, str], tmp: str,
+                new_bytes: int, orig_bytes: int, records_in: int,
+                records_out: int, tick_lo, tick_hi,
+                tiles_info: Optional[Dict]) -> Optional[Dict]:
+        """Shared commit tail: manifest flip -> swap -> unlink, with
+        the crash seams every fold shape shares."""
+        out_seq = rng[0]
+        prog_path = _seg_path(self.wal_dir, out_seq) + _PROGRESS_SUFFIX
         self._crash_point("compact_before_flip")
         manifest = read_compact_manifest(self.wal_dir) or {
             "schema": COMPACT_SCHEMA, "gen": 0, "ranges": [],
@@ -381,10 +597,12 @@ class WalCompactor:
             "bytes": new_bytes,
             "orig_bytes": orig_bytes,
             "records_in": records_in,
-            "records_out": len(out_records),
+            "records_out": records_out,
             "tick_lo": tick_lo,
             "tick_hi": tick_hi,
         }
+        if tiles_info is not None:
+            entry["tiles"] = tiles_info
         manifest["gen"] = gen
         manifest["ranges"] = ([e for e in manifest["ranges"]
                                if e["out"] != out_seq] + [entry])
@@ -398,9 +616,13 @@ class WalCompactor:
             # swapping now would resurrect a pre-anchor segment. The
             # replay-side cost would only be dedup work, but don't.
             os.remove(tmp)
+            if os.path.exists(prog_path):
+                os.remove(prog_path)
             return None
         os.replace(tmp, segs[out_seq])
         _fsync_dir(self.wal_dir)
+        if os.path.exists(prog_path):
+            os.remove(prog_path)
         self._crash_point("compact_before_unlink")
         for seq in rng[1:]:
             try:
@@ -415,17 +637,21 @@ class WalCompactor:
             "covers": [rng[0], rng[-1]],
             "segments": len(rng),
             "records_in": records_in,
-            "records_out": len(out_records),
+            "records_out": records_out,
             "orig_bytes": orig_bytes,
             "bytes": new_bytes,
             "reclaimed_bytes": max(0, orig_bytes - new_bytes),
             "gen": gen,
         }
+        if tiles_info is not None:
+            event["tiles"] = {"n": tiles_info["n"],
+                              "peak_tile_bytes":
+                                  tiles_info["peak_tile_bytes"]}
         with self._lock:
             self.folds += 1
             self.segments_folded += len(rng)
             self.records_in += records_in
-            self.records_out += len(out_records)
+            self.records_out += records_out
             self.reclaimed_bytes += event["reclaimed_bytes"]
             self._events.append(event)
         return event
@@ -446,6 +672,56 @@ class WalCompactor:
             os.fsync(f.fileno())
         return n
 
+    @staticmethod
+    def _append_records(path: str, records: List[Dict],
+                        at: Optional[int]) -> int:
+        """Append pickled frames to a tmp segment at byte offset
+        ``at``, truncating anything beyond it first (a torn tile
+        append from a crashed attempt). ``at=None`` (re)creates the
+        file with the WAL magic. Returns the new end offset."""
+        import pickle
+
+        if at is None:
+            with open(path, "wb") as f:
+                f.write(_MAGIC)
+                f.flush()
+                os.fsync(f.fileno())
+            at = len(_MAGIC)
+        with open(path, "r+b") as f:
+            f.truncate(at)
+            f.seek(at)
+            n = at
+            for rec in records:
+                body = pickle.dumps(rec)
+                f.write(_HEADER.pack(len(body), zlib.crc32(body)))
+                f.write(body)
+                n += _HEADER.size + len(body)
+            f.flush()
+            os.fsync(f.fileno())
+        return n
+
+    @staticmethod
+    def _read_progress(path: str) -> Optional[Dict]:
+        """The tile-progress sidecar as a dict, or None when absent or
+        unusable (a torn/alien sidecar just means a fresh fold)."""
+        try:
+            with open(path) as f:
+                prog = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if prog.get("schema") != PROGRESS_SCHEMA:
+            return None
+        return prog
+
+    def _write_progress(self, path: str, prog: Dict) -> None:
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(prog, f, indent=2, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(self.wal_dir)
+
     def _flip_manifest(self, manifest: Dict) -> None:
         path = os.path.join(self.wal_dir, COMPACT_MANIFEST_FILE)
         tmp = path + ".tmp"
@@ -459,14 +735,28 @@ class WalCompactor:
     # -- interrupted-pass recovery -----------------------------------------
 
     def _recover_interrupted(self) -> None:
-        """Roll an interrupted pass forward (flip happened) or back
-        (it didn't), and prune manifest entries for segments a later
-        checkpoint truncated away."""
+        """Roll an interrupted pass forward (flip happened), back (it
+        didn't), or *hold* it (a tiled pass with a valid progress
+        sidecar resumes in the next fold), and prune manifest entries
+        for segments a later checkpoint truncated away."""
         manifest = read_compact_manifest(self.wal_dir)
         entries = {e["out"]: e for e in
                    (manifest or {}).get("ranges", [])}
         changed = False
         for fname in sorted(os.listdir(self.wal_dir)):
+            if fname.endswith(_PROGRESS_SUFFIX + ".tmp"):
+                # torn sidecar flip: the flipped sidecar (if any) is
+                # authoritative, the half-written one is garbage
+                os.remove(os.path.join(self.wal_dir, fname))
+                continue
+            if fname.endswith(_PROGRESS_SUFFIX):
+                # orphan sidecar (pass completed, crash before the
+                # sidecar unlink): harmless, drop it
+                base = fname[:-len(_PROGRESS_SUFFIX)]
+                if not os.path.exists(os.path.join(
+                        self.wal_dir, base + _TMP_SUFFIX)):
+                    os.remove(os.path.join(self.wal_dir, fname))
+                continue
             if not fname.endswith(_TMP_SUFFIX):
                 continue
             tmp = os.path.join(self.wal_dir, fname)
@@ -482,11 +772,26 @@ class WalCompactor:
                     and self._tmp_valid(tmp, seq)):
                 # crashed between flip and swap: roll forward
                 os.replace(tmp, os.path.join(self.wal_dir, seg_name))
+                prog_path = tmp[:-len(_TMP_SUFFIX)] + _PROGRESS_SUFFIX
+                if os.path.exists(prog_path):
+                    os.remove(prog_path)
                 _fsync_dir(self.wal_dir)
+            elif (self.tile_bytes and self.tile_bytes > 0
+                  and self._read_progress(
+                      tmp[:-len(_TMP_SUFFIX)] + _PROGRESS_SUFFIX)
+                  is not None):
+                # a tiled pass died mid-fold before its flip: the
+                # originals are still authoritative (nothing swapped),
+                # and the sidecar lets the next fold resume finished
+                # tiles instead of refolding — hold the tmp
+                continue
             else:
                 # crashed before the flip (or the tmp is torn): the
                 # originals are authoritative — roll back
                 os.remove(tmp)
+                prog_path = tmp[:-len(_TMP_SUFFIX)] + _PROGRESS_SUFFIX
+                if os.path.exists(prog_path):
+                    os.remove(prog_path)
                 if ent is not None:
                     del entries[seq]
                     changed = True
@@ -583,4 +888,7 @@ class WalCompactor:
         reg.gauge(f"{name}.reclaimable_bytes", self.reclaimable_bytes)
         reg.gauge(f"{name}.log_bytes", self.log_bytes)
         reg.gauge(f"{name}.restarts", lambda: self.restarts)
+        reg.gauge(f"{name}.tile_folds", lambda: self.tile_folds)
+        reg.gauge(f"{name}.peak_tile_bytes",
+                  lambda: self.peak_tile_bytes)
         self._metric_names.append((reg, name))

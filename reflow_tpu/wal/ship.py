@@ -51,6 +51,7 @@ import zlib
 from typing import Callable, Dict, List, NamedTuple, Optional, Tuple
 
 from reflow_tpu.obs import trace as _trace
+from reflow_tpu.utils.config import env_int
 from reflow_tpu.utils.runtime import named_lock
 from reflow_tpu.obs.registry import REGISTRY
 from reflow_tpu.wal.compact import (COMPACT_MANIFEST_FILE,
@@ -256,6 +257,13 @@ class SegmentShipper:
         #: compacted range (wal/compact.py) — the truncation re-anchor
         #: path extended to rewritten-in-place segments
         self.compact_reanchors = 0
+        #: tile-unit bootstrap transfers: checkpoint files shipped as
+        #: independently CRC-framed units (REFLOW_TILE_BYTES > 0 and a
+        #: follower exposing receive_ckpt_tile) — a NACK re-fetches one
+        #: tile, not the chain
+        self.tile_units_shipped = 0
+        self.tile_unit_retries = 0
+        self.tile_bootstraps = 0
         #: (mtime_ns, {out_seq: entry}) cache of the compaction
         #: manifest so the hot shipping path stats instead of parsing
         self._compact_cache: Tuple[Optional[int], Dict[int, dict]] = \
@@ -309,10 +317,86 @@ class SegmentShipper:
         # later rewrite of that segment invalidates the cursor again
         st.anchor_gen = self._compact_gen()
         if self.ckpt_dir is not None and checkpoint_exists(self.ckpt_dir):
+            if env_int("REFLOW_TILE_BYTES") > 0 \
+                    and hasattr(st.follower, "receive_ckpt_tile"):
+                cursor = self._bootstrap_tiles(st)
+                if cursor is not None:
+                    return cursor
+                # exhausted retries or a mid-transfer surprise: the
+                # plain whole-directory bootstrap is always correct
             return tuple(st.follower.bootstrap(self.ckpt_dir))
         segs = list_segments(self.wal_dir)
         first = segs[0][0] if segs else 0
         return (first, len(_MAGIC))
+
+    def _bootstrap_tiles(self,
+                         st: _FollowerState) -> Optional[Tuple[int, int]]:
+        """Ship the checkpoint directory file-by-file as independently
+        CRC-framed units (``reflow.tile_ship/1``): each tile file of a
+        tiled checkpoint travels alone, so a NACK re-fetches one tile
+        instead of the whole chain. ``meta.pkl`` is deliberately sent
+        last — it names every tile file, so a torn transfer can never
+        look complete to the receiver. Returns the follower's anchored
+        cursor, or None to fall back to the plain bootstrap."""
+        try:
+            files = []
+            for root, _dirs, names in os.walk(self.ckpt_dir):
+                for n in sorted(names):
+                    if n.endswith(".tmp"):
+                        continue
+                    p = os.path.join(root, n)
+                    files.append((os.path.relpath(p, self.ckpt_dir), p))
+        except OSError:
+            return None
+        if not files:
+            return None
+        files.sort(key=lambda fp: (fp[0] == "meta.pkl", fp[0]))
+        retries = max(1, env_int("REFLOW_TILE_SHIP_RETRIES"))
+        total = len(files)
+        cursor = None
+        for i, (rel, path) in enumerate(files):
+            try:
+                with open(path, "rb") as f:
+                    payload = f.read()
+            except OSError:
+                # the chain rotated under us (a reaped tile file):
+                # this transfer is stale, start over via the fallback
+                return None
+            unit = {"schema": "reflow.tile_ship/1",
+                    "rel": rel.replace(os.sep, "/"), "idx": i,
+                    "total": total, "payload": payload,
+                    "crc": zlib.crc32(payload) & 0xFFFFFFFF,
+                    "last": i == total - 1}
+            ok = False
+            for attempt in range(retries):
+                t0 = time.perf_counter()
+                try:
+                    resp = st.follower.receive_ckpt_tile(unit)
+                except Exception:  # noqa: BLE001 - transport-level miss
+                    resp = None
+                accepted = bool(resp) and bool(resp.get("ok"))
+                if _trace.ENABLED:
+                    _trace.evt("tile_ship", t0,
+                               time.perf_counter() - t0,
+                               track="wal-shipper",
+                               args={"follower": st.name, "rel": unit["rel"],
+                                     "idx": i, "total": total,
+                                     "bytes": len(payload),
+                                     "attempt": attempt,
+                                     "ok": accepted})
+                if accepted:
+                    ok = True
+                    self.tile_units_shipped += 1
+                    if unit["last"]:
+                        cursor = resp.get("cursor")
+                    break
+                self.tile_unit_retries += 1
+            if not ok:
+                return None
+        if cursor is None:
+            return None
+        self.tile_bootstraps += 1
+        return tuple(cursor)
 
     # -- shipping ----------------------------------------------------------
 
@@ -606,6 +690,9 @@ class SegmentShipper:
             "nacks": self.nacks,
             "retransmit_bytes": self.retransmit_bytes,
             "link_stalls": self.link_stalls,
+            "tile_units_shipped": self.tile_units_shipped,
+            "tile_unit_retries": self.tile_unit_retries,
+            "tile_bootstraps": self.tile_bootstraps,
             "followers": followers,
         }
         if transport:
@@ -678,6 +765,10 @@ class SegmentShipper:
         reg.gauge(f"{name}.link_stalls", lambda: self.link_stalls)
         reg.gauge(f"{name}.compact_reanchors",
                   lambda: self.compact_reanchors)
+        reg.gauge(f"{name}.tile_units_shipped",
+                  lambda: self.tile_units_shipped)
+        reg.gauge(f"{name}.tile_bootstraps",
+                  lambda: self.tile_bootstraps)
         reg.gauge("net.reconnects_total", self._net_reconnects_total)
         reg.gauge("net.retransmit_bytes", lambda: self.retransmit_bytes)
         self._metric_names.append((reg, name))

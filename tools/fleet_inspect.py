@@ -115,6 +115,13 @@ def _print_fleet(snap: dict) -> None:
     debt = g.get("compact_debt_bytes")
     if debt is not None:
         print(f"  compaction debt: {int(debt)} byte(s)")
+    tpeak = g.get("tile_peak_bytes")
+    stiles = g.get("snapshot_tiles")
+    if tpeak is not None or stiles is not None:
+        print(f"  tiles: peak resident "
+              f"{'n/a' if tpeak is None else int(tpeak)} byte(s)   "
+              f"published snapshot tiles: "
+              f"{'n/a' if stiles is None else int(stiles)}")
     if g.get("subs_active") is not None:
         rows = g.get("sub_rows_s")
         lag = g.get("sub_lag_windows")

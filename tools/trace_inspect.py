@@ -282,6 +282,19 @@ def inspect(path, require_chain=None) -> dict:
     replay_by_replica: dict = defaultdict(
         lambda: {"shipments": 0, "records_applied": 0, "replay_ms": 0.0,
                  "horizon": 0, "lag_ticks": 0, "max_lag_ticks": 0})
+    # tiled maintenance (wal/compact.py, utils/checkpoint.py,
+    # wal/ship.py): compact_tile per folded key-range tile (resident
+    # fold bytes), ckpt_tile per checkpoint tile frame (full/delta),
+    # tile_ship per checkpoint file shipped as a CRC-framed unit —
+    # together the bounded-peak-memory evidence for a tiled pass
+    tiles_acc = {
+        "compact_tile": {"tiles": 0, "ms": 0.0, "parts": 0,
+                         "max_resident_bytes": 0},
+        "ckpt_tile": {"tiles": 0, "ms": 0.0, "full": 0, "delta": 0,
+                      "max_bytes": 0},
+        "tile_ship": {"units": 0, "ms": 0.0, "bytes": 0,
+                      "retries": 0, "rejects": 0},
+    }
     # failover (serve/failover.py, serve/replica.py, wal/log.py):
     # failover_elect marks the decision, failover_replay the winner's
     # mirrored-prefix replay, fence_reject every zombie write the new
@@ -393,6 +406,36 @@ def inspect(path, require_chain=None) -> dict:
                 lag = int(a.get("lag_ticks", 0) or 0)
                 st["lag_ticks"] = lag
                 st["max_lag_ticks"] = max(st["max_lag_ticks"], lag)
+            if ev.get("name") == "compact_tile":
+                a = ev.get("args") or {}
+                st = tiles_acc["compact_tile"]
+                st["tiles"] += 1
+                st["ms"] += float(ev.get("dur", 0.0)) / 1e3
+                st["parts"] += int(a.get("parts", 0) or 0)
+                st["max_resident_bytes"] = max(
+                    st["max_resident_bytes"],
+                    int(a.get("resident_bytes", 0) or 0))
+            if ev.get("name") == "ckpt_tile":
+                a = ev.get("args") or {}
+                st = tiles_acc["ckpt_tile"]
+                st["tiles"] += 1
+                st["ms"] += float(ev.get("dur", 0.0)) / 1e3
+                kind = a.get("kind")
+                if kind in ("full", "delta"):
+                    st[kind] += 1
+                st["max_bytes"] = max(st["max_bytes"],
+                                      int(a.get("bytes", 0) or 0))
+            if ev.get("name") == "tile_ship":
+                a = ev.get("args") or {}
+                st = tiles_acc["tile_ship"]
+                st["ms"] += float(ev.get("dur", 0.0)) / 1e3
+                if a.get("ok", True):
+                    st["units"] += 1
+                    st["bytes"] += int(a.get("bytes", 0) or 0)
+                else:
+                    st["rejects"] += 1
+                if int(a.get("attempt", 0) or 0) > 0:
+                    st["retries"] += 1
             if ev.get("name") == "failover_elect":
                 a = ev.get("args") or {}
                 failover_events.append({
@@ -604,6 +647,13 @@ def inspect(path, require_chain=None) -> dict:
         freshness = _freshness_summary(
             [(tok, ch) for tok, ch in chains.items()
              if all(name in ch["links"] for name in FRESHNESS_SPANS)])
+    tiles = None
+    if any(st["tiles"] for k, st in tiles_acc.items()
+           if "tiles" in st) or tiles_acc["tile_ship"]["units"] \
+            or tiles_acc["tile_ship"]["rejects"]:
+        for st in tiles_acc.values():
+            st["ms"] = round(st["ms"], 3)
+        tiles = tiles_acc
     failover = None
     if failover_events or fence_rejects:
         failover = {
@@ -631,6 +681,7 @@ def inspect(path, require_chain=None) -> dict:
         "dispatch_by_depth": dispatch_by_depth,
         "per_device": per_device,
         "replication": replication,
+        "tiles": tiles,
         "network": network,
         "causal": causal,
         "control_actions": control_actions,
@@ -688,6 +739,22 @@ def _print_human(s: dict) -> None:
             print(f"  ship->{name}: {d['shipments']} shipment(s) "
                   f"{d['bytes']} byte(s) in {d['ship_ms']:.2f}ms, "
                   f"{d['nacks']} nack(s)")
+    ti = s.get("tiles")
+    if ti:
+        ct, kt, sh = ti["compact_tile"], ti["ckpt_tile"], ti["tile_ship"]
+        if ct["tiles"]:
+            print(f"tiles: compacted {ct['tiles']} tile(s) "
+                  f"({ct['parts']} part record(s)) in {ct['ms']:.2f}ms, "
+                  f"max resident {ct['max_resident_bytes']} byte(s)")
+        if kt["tiles"]:
+            print(f"tiles: checkpointed {kt['tiles']} tile frame(s) "
+                  f"({kt['full']} full, {kt['delta']} delta) in "
+                  f"{kt['ms']:.2f}ms, max frame {kt['max_bytes']} "
+                  f"byte(s)")
+        if sh["units"] or sh["rejects"]:
+            print(f"tiles: shipped {sh['units']} ckpt unit(s) "
+                  f"{sh['bytes']} byte(s) in {sh['ms']:.2f}ms, "
+                  f"{sh['retries']} retried, {sh['rejects']} rejected")
     net = s.get("network")
     if net:
         for link, d in net.items():

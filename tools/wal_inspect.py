@@ -117,6 +117,7 @@ def inspect(wal_dir: str, *, verbose: bool = True,
         if (rec.get("kind") == "ckpt" and p
                 and p not in ckpt_roots):
             ckpt_roots.append(p)
+    chains = _chain_summary(ckpt_roots)
     return {
         # same schema family as reflow_tpu.obs snapshots / trace_inspect
         "schema": "reflow.wal_inspect/1",
@@ -140,9 +141,73 @@ def inspect(wal_dir: str, *, verbose: bool = True,
         "segments_detail": [per_seg[s] for s in sorted(per_seg)],
         "shipping": shipping,
         "compaction": compaction,
-        "checkpoint_chain": _chain_summary(ckpt_roots),
+        "checkpoint_chain": chains,
+        "tiles": _tiles_summary(wal_dir, compaction, chains),
         "epochs": _epoch_summary(wal_dir, max_epoch),
         "torn_tail": torn._asdict() if torn is not None else None,
+    }
+
+
+def _tiles_summary(wal_dir: str, compaction, chains):
+    """Key-range tiled maintenance state (REFLOW_TILE_BYTES > 0): tiled
+    compaction ranges (count, budget, peak resident bytes, per-tile fold
+    generations), interrupted-pass recovery sidecars awaiting a
+    roll-forward resume (``*.compact.progress``), and tiled checkpoint
+    chains. None when nothing in this log was ever tiled."""
+    interrupted = []
+    try:
+        names = sorted(os.listdir(wal_dir))
+    except OSError:
+        names = []
+    for n in names:
+        if not n.endswith(".compact.progress"):
+            continue
+        path = os.path.join(wal_dir, n)
+        try:
+            with open(path) as f:
+                prog = json.load(f)
+        except (OSError, ValueError) as e:
+            interrupted.append({"sidecar": n, "error": str(e)})
+            continue
+        interrupted.append({
+            "sidecar": n,
+            "attempt": prog.get("attempt"),
+            "budget": prog.get("budget"),
+            "tiles_total": len(prog.get("plan") or []),
+            "tiles_done": len(prog.get("done") or []),
+        })
+    ranges = []
+    count = 0
+    peak = 0
+    budget = 0
+    if isinstance(compaction, dict):
+        for ent in compaction.get("ranges", []):
+            ti = ent.get("tiles")
+            if not ti:
+                continue
+            ranges.append({"out": ent["out"], "n": ti.get("n"),
+                           "budget": ti.get("budget"),
+                           "peak_tile_bytes": ti.get("peak_tile_bytes"),
+                           "gens": ti.get("gens"),
+                           "resumed_tiles": ti.get("resumed_tiles")})
+            count += int(ti.get("n") or 0)
+            peak = max(peak, int(ti.get("peak_tile_bytes") or 0))
+            budget = max(budget, int(ti.get("budget") or 0))
+    chain_tiles = []
+    for ch in chains or []:
+        ti = ch.get("tiles")
+        if ti:
+            chain_tiles.append({"root": ch.get("root"), **ti})
+            budget = max(budget, int(ti.get("budget") or 0))
+    if not ranges and not interrupted and not chain_tiles:
+        return None
+    return {
+        "budget": budget,
+        "tile_count": count,
+        "peak_tile_bytes": peak,
+        "compact_ranges": ranges,
+        "interrupted": interrupted or None,
+        "chains": chain_tiles or None,
     }
 
 
@@ -216,6 +281,7 @@ def _chain_summary(roots: list):
             "horizon": m.get("horizon"),
             "wal_pos": m.get("wal_pos"),
             "saves": m.get("saves"),
+            "tiles": m.get("tiles"),
             "broken_links": missing,
         })
     return chains or None
@@ -360,6 +426,28 @@ def main(argv=None) -> int:
                   f"({ch['delta_bytes']} bytes) "
                   f"horizon={ch['horizon']} "
                   f"wal_pos={ch['wal_pos']}{broken}")
+        tiles = summary["tiles"]
+        if tiles:
+            print(f"tiles: budget={tiles['budget']} "
+                  f"count={tiles['tile_count']} "
+                  f"peak_tile_bytes={tiles['peak_tile_bytes']}")
+            for rng_ in tiles["compact_ranges"]:
+                print(f"  compact out={rng_['out']:08d}: "
+                      f"{rng_['n']} tile(s) "
+                      f"peak={rng_['peak_tile_bytes']} "
+                      f"gens={rng_['gens']} "
+                      f"resumed={rng_['resumed_tiles']}")
+            for it in tiles["interrupted"] or []:
+                if "error" in it:
+                    print(f"  INTERRUPTED {it['sidecar']}: {it['error']}")
+                else:
+                    print(f"  INTERRUPTED {it['sidecar']}: "
+                          f"{it['tiles_done']}/{it['tiles_total']} "
+                          f"tile(s) done, attempt={it['attempt']} — "
+                          f"next pass resumes without refolding")
+            for ct in tiles["chains"] or []:
+                print(f"  chain {ct['root']}: {ct['count']} tile(s) "
+                      f"peak={ct['peak_tile_bytes']}")
         if ship and "followers" in ship:
             print(f"shipping: horizon={tuple(ship['horizon'])} "
                   f"leader_tick={ship['leader_tick']} "
